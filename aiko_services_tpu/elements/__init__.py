@@ -1,0 +1,8 @@
+from .common_io import (                                      # noqa: F401
+    DataSource, DataTarget, expand_data_sources)
+from .text_io import (                                        # noqa: F401
+    TextReadFile, TextSource, TextTransform, TextSample, TextWriteFile,
+    TextOutput)
+from .toys import (                                           # noqa: F401
+    PE_Number, PE_Add, PE_Multiply, PE_Sum2, PE_Inspect, PE_Metrics,
+    PE_RandomIntegers)

@@ -1,0 +1,100 @@
+# DataSource / DataTarget base elements.
+#
+# Capability parity with the reference media I/O bases (reference:
+# src/aiko_services/elements/media/common_io.py:22-151): a DataSource turns a
+# "data_sources" parameter (file path(s), glob patterns, or in-memory items)
+# into a stream of frames -- single item goes through the no-thread fast path
+# (create_frame), multiple items run on a frame-generator thread with
+# optional rate throttling and batching; a DataTarget consumes frames into
+# "data_targets" (templated file paths).
+
+from __future__ import annotations
+
+import glob as globlib
+from pathlib import Path
+
+from ..pipeline import PipelineElement, StreamEvent
+
+__all__ = ["DataSource", "DataTarget", "expand_data_sources"]
+
+
+def expand_data_sources(data_sources) -> list:
+    """Expand path patterns: "file://path" prefixes, globs, lists."""
+    if data_sources is None:
+        return []
+    if isinstance(data_sources, (str, Path)):
+        data_sources = [data_sources]
+    expanded = []
+    for source in data_sources:
+        if not isinstance(source, str):
+            expanded.append(source)
+            continue
+        path = source[len("file://"):] if source.startswith("file://") else (
+            source)
+        if any(character in path for character in "*?["):
+            expanded.extend(sorted(globlib.glob(path)))
+        else:
+            expanded.append(path)
+    return expanded
+
+
+class DataSource(PipelineElement):
+    """Subclasses implement read_item(stream, item) -> frame_data dict."""
+
+    def start_stream(self, stream, stream_id):
+        data_sources = self.get_parameter("data_sources", None, stream)
+        items = expand_data_sources(data_sources)
+        if not items:
+            return StreamEvent.ERROR, {"diagnostic": "no data_sources"}
+        rate = self.get_parameter("rate", None, stream)
+        rate = float(rate) if rate else None
+        stream.variables[f"{self.definition.name}.items"] = items
+        if len(items) == 1 and rate is None:
+            # fast path: single item, no generator thread
+            # (reference common_io.py:96-102)
+            try:
+                frame_data = self.read_item(stream, items[0])
+            except Exception as error:
+                return StreamEvent.ERROR, {"diagnostic": str(error)}
+            self.create_frame(stream, frame_data)
+            return StreamEvent.OKAY, None
+        self.create_frames(stream, self._frame_generator, rate=rate)
+        return StreamEvent.OKAY, None
+
+    def _frame_generator(self, stream, frame_id):
+        items = stream.variables[f"{self.definition.name}.items"]
+        cursor_key = f"{self.definition.name}.cursor"
+        cursor = stream.variables.get(cursor_key, 0)
+        if cursor >= len(items):
+            return StreamEvent.STOP, {"diagnostic": "data sources exhausted"}
+        stream.variables[cursor_key] = cursor + 1
+        return StreamEvent.OKAY, self.read_item(stream, items[cursor])
+
+    def read_item(self, stream, item) -> dict:
+        raise NotImplementedError
+
+    def process_frame(self, stream, **inputs):
+        # sources inject frames; a frame passing through is forwarded as-is
+        return StreamEvent.OKAY, inputs
+
+
+class DataTarget(PipelineElement):
+    """Subclasses implement write_item(stream, path, **inputs)."""
+
+    def start_stream(self, stream, stream_id):
+        data_targets = self.get_parameter("data_targets", None, stream)
+        targets = expand_data_sources(data_targets)
+        if not targets:
+            return StreamEvent.ERROR, {"diagnostic": "no data_targets"}
+        stream.variables[f"{self.definition.name}.target"] = targets[0]
+        stream.variables[f"{self.definition.name}.count"] = 0
+        return StreamEvent.OKAY, None
+
+    def next_target_path(self, stream) -> str:
+        """Template "{}" in the target expands to the write counter."""
+        template = stream.variables[f"{self.definition.name}.target"]
+        count_key = f"{self.definition.name}.count"
+        count = stream.variables[count_key]
+        stream.variables[count_key] = count + 1
+        return (template.format(count) if "{" in str(template)
+                else str(template))

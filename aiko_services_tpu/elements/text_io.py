@@ -1,0 +1,74 @@
+# Text I/O elements: the pipeline correctness suite.
+#
+# Capability parity with the reference text elements (reference:
+# src/aiko_services/elements/media/text_io.py:64-179): TextReadFile,
+# TextTransform (case operations), TextSample (drop-frame by rate -- the
+# reference's documented local/remote drop-frame test vehicle,
+# text_io.py:21-26), TextWriteFile, TextOutput.
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from ..pipeline import StreamEvent, PipelineElement
+from .common_io import DataSource, DataTarget
+
+__all__ = ["TextReadFile", "TextTransform", "TextSample", "TextWriteFile",
+           "TextOutput", "TextSource"]
+
+
+class TextReadFile(DataSource):
+    def read_item(self, stream, item) -> dict:
+        return {"text": Path(item).read_text()}
+
+
+class TextSource(DataSource):
+    """In-memory text source: data_sources is a list of strings."""
+
+    def read_item(self, stream, item) -> dict:
+        return {"text": str(item)}
+
+
+class TextTransform(PipelineElement):
+    def process_frame(self, stream, text):
+        transform = self.get_parameter("transform", "none", stream)
+        if transform == "lower":
+            text = text.lower()
+        elif transform == "upper":
+            text = text.upper()
+        elif transform == "title":
+            text = text.title()
+        elif transform != "none":
+            return StreamEvent.ERROR, {
+                "diagnostic": f"unknown transform: {transform}"}
+        return StreamEvent.OKAY, {"text": text}
+
+
+class TextSample(PipelineElement):
+    """Pass every Nth frame, drop the rest (reference text_io.py:108-115)."""
+
+    def process_frame(self, stream, text):
+        sample_rate = int(self.get_parameter("sample_rate", 1, stream))
+        counter_key = f"{self.definition.name}.counter"
+        counter = stream.variables.get(counter_key, 0)
+        stream.variables[counter_key] = counter + 1
+        if sample_rate > 1 and counter % sample_rate != 0:
+            return StreamEvent.DROP_FRAME, {}
+        return StreamEvent.OKAY, {"text": text}
+
+
+class TextWriteFile(DataTarget):
+    def process_frame(self, stream, text):
+        path = self.next_target_path(stream)
+        Path(path).write_text(text)
+        return StreamEvent.OKAY, {"path": path}
+
+
+class TextOutput(PipelineElement):
+    """Collect text into stream variables (assertion point for tests,
+    like the reference PE_Inspect idiom)."""
+
+    def process_frame(self, stream, text):
+        collected = stream.variables.setdefault("text_output", [])
+        collected.append(text)
+        return StreamEvent.OKAY, {"text": text}

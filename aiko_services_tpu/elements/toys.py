@@ -1,0 +1,80 @@
+# Toy pipeline elements: arithmetic diamonds, inspection, metrics.
+#
+# Capability parity with the reference example elements (reference:
+# src/aiko_services/examples/pipeline/elements.py:26-324): PE_0..PE_4-style
+# arithmetic for fan-out/fan-in graphs, PE_Inspect (swag dump), PE_Metrics
+# (per-element timing report), PE_RandomIntegers (seeded generator).
+
+from __future__ import annotations
+
+from ..pipeline import PipelineElement, StreamEvent
+from ..utils import get_logger
+from .common_io import DataSource
+
+__all__ = ["PE_Number", "PE_Add", "PE_Multiply", "PE_Sum2", "PE_Inspect",
+           "PE_Metrics", "PE_RandomIntegers"]
+
+_LOGGER = get_logger("toys")
+
+
+class PE_Number(DataSource):
+    """Emits frames {"number": n} from data_sources items."""
+
+    def read_item(self, stream, item) -> dict:
+        return {"number": int(item)}
+
+
+class PE_Add(PipelineElement):
+    def process_frame(self, stream, number):
+        constant = int(self.get_parameter("constant", 1, stream))
+        return StreamEvent.OKAY, {"number": int(number) + constant}
+
+
+class PE_Multiply(PipelineElement):
+    def process_frame(self, stream, number):
+        constant = int(self.get_parameter("constant", 2, stream))
+        return StreamEvent.OKAY, {"number": int(number) * constant}
+
+
+class PE_Sum2(PipelineElement):
+    """Fan-in join: sums two inputs (use with map_in for diamond graphs)."""
+
+    def process_frame(self, stream, a, b):
+        return StreamEvent.OKAY, {"number": int(a) + int(b)}
+
+
+class PE_Inspect(PipelineElement):
+    """Dump chosen swag keys to the log and a stream variable
+    (reference elements.py:68-123)."""
+
+    def process_frame(self, stream, **inputs):
+        inspected = stream.variables.setdefault("inspected", [])
+        inspected.append(dict(inputs))
+        if self.get_parameter("log", False, stream):
+            _LOGGER.info("%s inspect: %s", self.definition.name, inputs)
+        return StreamEvent.OKAY, dict(inputs)
+
+
+class PE_Metrics(PipelineElement):
+    """Report per-element frame timings (reference elements.py:133-149).
+    Reads frame metrics accumulated by the pipeline engine."""
+
+    def process_frame(self, stream, **inputs):
+        frame = stream.frames.get(max(stream.frames) if stream.frames
+                                  else None)
+        metrics = dict(frame.metrics) if frame else {}
+        history = stream.variables.setdefault("metrics_history", [])
+        history.append(metrics)
+        if self.get_parameter("log", False, stream):
+            _LOGGER.info("metrics: %s", metrics)
+        return StreamEvent.OKAY, {}
+
+
+class PE_RandomIntegers(DataSource):
+    """Deterministic pseudo-random integer source: data_sources items are
+    seeds; emits {"number": value}."""
+
+    def read_item(self, stream, item) -> dict:
+        seed = int(item)
+        value = (seed * 1103515245 + 12345) % 2147483648
+        return {"number": value % 100}

@@ -1,0 +1,194 @@
+# Pipeline definitions: the JSON document describing a pipeline graph.
+#
+# Capability parity with the reference definition layer (reference:
+# src/aiko_services/main/pipeline.py:142-178 dataclasses and the embedded
+# Avro schema :1323-1440): a pipeline has a name, a graph (S-expression path
+# list), pipeline-level parameters, and element definitions with typed
+# input/output ports and a deploy block that is either local
+# {module, class_name} or remote {service_filter}.  Validation is hand-rolled
+# schema checking (explicit error messages instead of Avro), plus the graph /
+# port cross-checks the reference does in PipelineGraph.validate
+# (reference pipeline.py:254-286) including map_in/map_out renames.
+#
+# TPU-first addition: element definitions may carry a "sharding" block
+# naming mesh axes for the element's compute (data/model/sequence), consumed
+# by parallel/mesh.py -- the reference has no counterpart (SURVEY.md 2.4).
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..utils import Graph
+
+__all__ = [
+    "PipelineDefinition", "ElementDefinition", "DefinitionError",
+    "parse_pipeline_definition", "validate_pipeline_definition",
+]
+
+
+class DefinitionError(ValueError):
+    pass
+
+
+@dataclass
+class ElementDefinition:
+    name: str
+    input: list = field(default_factory=list)    # [{"name":..,"type":..}]
+    output: list = field(default_factory=list)
+    parameters: dict = field(default_factory=dict)
+    deploy_local: dict | None = None     # {"module":.., "class_name":..}
+    deploy_remote: dict | None = None    # {"service_filter": {...}}
+    map_in: dict = field(default_factory=dict)   # input_name -> swag_key
+    map_out: dict = field(default_factory=dict)  # output_name -> swag_key
+    sharding: dict = field(default_factory=dict)  # TPU mesh axes block
+
+    @property
+    def is_local(self) -> bool:
+        return self.deploy_local is not None
+
+    def input_names(self) -> list[str]:
+        return [port["name"] for port in self.input]
+
+    def output_names(self) -> list[str]:
+        return [port["name"] for port in self.output]
+
+
+@dataclass
+class PipelineDefinition:
+    name: str
+    version: int = 0
+    runtime: str = "jax"
+    graph: list = field(default_factory=list)
+    parameters: dict = field(default_factory=dict)
+    elements: list = field(default_factory=list)
+
+    def element(self, name: str) -> ElementDefinition | None:
+        for definition in self.elements:
+            if definition.name == name:
+                return definition
+        return None
+
+
+def _require(condition, message):
+    if not condition:
+        raise DefinitionError(message)
+
+
+def _parse_ports(ports, element_name, direction) -> list:
+    _require(isinstance(ports, list),
+             f"{element_name}: '{direction}' must be a list")
+    parsed = []
+    for port in ports:
+        _require(isinstance(port, dict) and "name" in port,
+                 f"{element_name}: each {direction} port needs a 'name'")
+        parsed.append({"name": port["name"],
+                       "type": port.get("type", "any")})
+    return parsed
+
+
+def parse_pipeline_definition(source) -> PipelineDefinition:
+    """source: dict, JSON text, or a path to a JSON file."""
+    if isinstance(source, (str, Path)) and str(source).endswith(".json"):
+        with open(source) as handle:
+            document = json.load(handle)
+    elif isinstance(source, str):
+        document = json.loads(source)
+    else:
+        document = source
+    _require(isinstance(document, dict), "Definition must be a JSON object")
+    _require("name" in document, "Definition needs a 'name'")
+    _require("graph" in document and isinstance(document["graph"], list)
+             and document["graph"],
+             "Definition needs a non-empty 'graph' list")
+    _require("elements" in document and isinstance(document["elements"], list),
+             "Definition needs an 'elements' list")
+
+    elements = []
+    for record in document["elements"]:
+        _require(isinstance(record, dict) and "name" in record,
+                 "Each element needs a 'name'")
+        name = record["name"]
+        deploy = record.get("deploy", {})
+        local = deploy.get("local")
+        remote = deploy.get("remote")
+        _require((local is None) != (remote is None),
+                 f"{name}: deploy must be exactly one of local|remote")
+        if local is not None:
+            _require("module" in local and "class_name" in local,
+                     f"{name}: deploy.local needs module and class_name")
+        else:
+            _require("service_filter" in remote,
+                     f"{name}: deploy.remote needs service_filter")
+        elements.append(ElementDefinition(
+            name=name,
+            input=_parse_ports(record.get("input", []), name, "input"),
+            output=_parse_ports(record.get("output", []), name, "output"),
+            parameters=record.get("parameters", {}),
+            deploy_local=local,
+            deploy_remote=remote,
+            map_in=record.get("map_in", {}),
+            map_out=record.get("map_out", {}),
+            sharding=record.get("sharding", {}),
+        ))
+
+    definition = PipelineDefinition(
+        name=document["name"],
+        version=int(document.get("version", 0)),
+        runtime=document.get("runtime", "jax"),
+        graph=list(document["graph"]),
+        parameters=document.get("parameters", {}),
+        elements=elements,
+    )
+    validate_pipeline_definition(definition)
+    return definition
+
+
+def validate_pipeline_definition(definition: PipelineDefinition) -> Graph:
+    """Cross-check the graph against element definitions and port linking.
+
+    Mirrors the reference PipelineGraph.validate (pipeline.py:254-286):
+    every input of a non-head element must be produced by some predecessor's
+    output (after map_in/map_out renames) or supplied as initial frame data
+    for head elements.
+    """
+    names = [element.name for element in definition.elements]
+    _require(len(names) == len(set(names)),
+             f"Duplicate element names in {definition.name}")
+    graph = Graph.traverse(definition.graph)
+    for node_name in graph.node_names():
+        _require(definition.element(node_name) is not None,
+                 f"Graph node '{node_name}' has no element definition")
+
+    heads = set(graph.head_nodes())
+    for node_name in graph.get_path():
+        element = definition.element(node_name)
+        if node_name in heads:
+            continue  # head inputs come from create_frame data
+        available = set()
+        for predecessor in _ancestors(graph, node_name):
+            predecessor_def = definition.element(predecessor)
+            for output_name in predecessor_def.output_names():
+                available.add(
+                    predecessor_def.map_out.get(output_name, output_name))
+        for input_name in element.input_names():
+            swag_key = element.map_in.get(input_name, input_name)
+            _require(
+                swag_key in available,
+                f"{definition.name}: element '{node_name}' input "
+                f"'{input_name}' (swag key '{swag_key}') is not produced by "
+                f"any ancestor; available: {sorted(available)}")
+    return graph
+
+
+def _ancestors(graph: Graph, name: str) -> set:
+    result = set()
+    frontier = list(graph.predecessors(name))
+    while frontier:
+        node = frontier.pop()
+        if node in result:
+            continue
+        result.add(node)
+        frontier.extend(graph.predecessors(node))
+    return result

@@ -1,0 +1,165 @@
+# PipelineElement: one node of a pipeline graph.
+#
+# Capability parity with the reference element layer (reference:
+# src/aiko_services/main/pipeline.py:288-456): elements are Actors (remotely
+# discoverable/controllable), implement start_stream / process_frame /
+# stop_stream returning (StreamEvent, ...), can inject frames via
+# create_frame or a threaded frame generator (create_frames, reference
+# pipeline.py:365-416), and resolve parameters with stream > element >
+# pipeline precedence (reference pipeline.py:422-456).
+#
+# The TPU compute contract lives in ComputeElement (tpu_element.py): element
+# math is a pure JAX function jitted once and fed jax.Array swag values.
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..runtime import Actor
+from ..utils import get_logger
+from .stream import Stream, StreamEvent, StreamState
+
+__all__ = ["PipelineElement", "FrameGeneratorHandle"]
+
+_LOGGER = get_logger("element")
+
+
+class FrameGeneratorHandle:
+    """Owns one frame-generator thread for (element, stream)."""
+
+    def __init__(self, element, stream: Stream, frame_generator, rate=None,
+                 frame_window: int = 16):
+        self.element = element
+        self.stream = stream
+        self.frame_generator = frame_generator
+        self.rate = rate
+        self.frame_window = frame_window
+        self._terminated = False
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"frames-{element.name}-{stream.stream_id}", daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def terminate(self):
+        self._terminated = True
+
+    def _run(self):
+        pipeline = self.element.pipeline
+        stream = self.stream
+        interval = 1.0 / self.rate if self.rate else 0.0
+        next_time = time.monotonic()
+        while not self._terminated and stream.state == StreamState.RUN:
+            # backpressure: bound in-flight frames so a fast generator
+            # cannot grow the pipeline mailbox without limit
+            if stream.pending >= self.frame_window:
+                time.sleep(0.0005)
+                continue
+            try:
+                stream_event, frame_data = self.frame_generator(
+                    stream, stream.frame_id)
+            except Exception as error:
+                _LOGGER.error("%s: frame generator failed: %s",
+                              self.element.name, error)
+                pipeline.post_message(
+                    "destroy_stream", [stream.stream_id, "error", True])
+                return
+            if stream_event == StreamEvent.OKAY:
+                pipeline.create_frame(stream, frame_data or {})
+            elif stream_event == StreamEvent.STOP:
+                # post through the mailbox so the destroy is ordered AFTER
+                # already-posted frames, then drains gracefully
+                pipeline.post_message(
+                    "destroy_stream", [stream.stream_id, "stop", True])
+                return
+            elif stream_event == StreamEvent.ERROR:
+                _LOGGER.error("%s: frame generator error: %s",
+                              self.element.name, frame_data)
+                pipeline.post_message(
+                    "destroy_stream", [stream.stream_id, "error", True])
+                return
+            # DROP_FRAME: skip this tick
+            if interval:
+                next_time += interval
+                delay = next_time - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+
+
+class PipelineElement(Actor):
+    def __init__(self, process, pipeline, definition):
+        self.pipeline = pipeline
+        self.definition = definition
+        name = f"{pipeline.name}.{definition.name}" if pipeline else (
+            definition.name)
+        super().__init__(process, name)
+        self.share.update(dict(definition.parameters))
+        self._generators: dict[str, FrameGeneratorHandle] = {}
+
+    # -- the element contract (override these) -----------------------------
+
+    def start_stream(self, stream: Stream, stream_id) -> tuple:
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream: Stream, **inputs) -> tuple:
+        raise NotImplementedError
+
+    def stop_stream(self, stream: Stream, stream_id) -> tuple:
+        return StreamEvent.OKAY, None
+
+    # -- frame creation ----------------------------------------------------
+
+    def create_frame(self, stream: Stream, frame_data: dict) -> None:
+        self.pipeline.create_frame(stream, frame_data)
+
+    def create_frames(self, stream: Stream, frame_generator,
+                      rate: float = None) -> None:
+        """Spawn the frame-generator thread for a DataSource element
+        (reference pipeline.py:365-416)."""
+        window = int(self.get_parameter("frame_window", 16, stream))
+        handle = FrameGeneratorHandle(
+            self, stream, frame_generator, rate=rate, frame_window=window)
+        self._generators[stream.stream_id] = handle
+        handle.start()
+
+    def stop_frame_generation(self, stream_id) -> None:
+        handle = self._generators.pop(stream_id, None)
+        if handle:
+            handle.terminate()
+
+    # -- parameters (reference pipeline.py:422-456) ------------------------
+
+    def get_parameter(self, name: str, default=None, stream: Stream = None):
+        """Resolution order: stream "Element.name"-scoped -> stream ->
+        element share/definition -> pipeline share/definition -> default."""
+        if stream is not None:
+            scoped = f"{self.definition.name}.{name}"
+            if scoped in stream.parameters:
+                return stream.parameters[scoped]
+            if name in stream.parameters:
+                return stream.parameters[name]
+        if name in self.share:
+            return self.share[name]
+        if self.pipeline is not None:
+            pipeline_share = getattr(self.pipeline, "share", {})
+            if name in pipeline_share:
+                return pipeline_share[name]
+            pipeline_definition = getattr(self.pipeline, "definition", None)
+            if (pipeline_definition is not None
+                    and name in pipeline_definition.parameters):
+                return pipeline_definition.parameters[name]
+        return default
+
+    def set_parameter(self, name: str, value) -> None:
+        if self.ec_producer is not None:
+            self.ec_producer.update(name, value)
+        else:
+            self.share[name] = value
+
+    def stop(self) -> None:
+        for handle in self._generators.values():
+            handle.terminate()
+        self._generators.clear()
+        super().stop()

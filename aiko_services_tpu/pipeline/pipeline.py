@@ -1,0 +1,482 @@
+# Pipeline engine: executes dataflow graphs of PipelineElements over
+# streams of frames.
+#
+# Capability parity with the reference pipeline engine (reference:
+# src/aiko_services/main/pipeline.py:522-1283): graph construction from the
+# definition (local elements loaded by module/class, remote elements
+# discovered by service filter), stream lifecycle with grace-time leases,
+# per-frame execution in topological order with map_in/map_out name mapping,
+# per-element wall-clock metrics, StreamEvent policy (ERROR destroys the
+# stream, STOP destroys gracefully, DROP_FRAME skips the rest of the graph),
+# remote element pause/resume (frame pauses at the remote node, resumes via
+# Graph.iterate_after on process_frame_response, reference
+# pipeline.py:1083-1160), the auto-created "*" default stream, response
+# routing (local queue | response topic | /out), and live parameter updates.
+#
+# TPU-first differences: swag values stay on device (jax.Array) in-process;
+# cross-process hops use the tensor codec; stream context is explicit (no
+# thread-locals); the event engine dispatches with microsecond latency.
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..runtime import Actor, ECProducer, Lease, ServiceFilter, ServicesCache
+from ..runtime.service import SERVICE_PROTOCOL_PIPELINE
+from ..utils import generate, get_logger, load_module
+from .definition import (
+    PipelineDefinition, parse_pipeline_definition,
+    validate_pipeline_definition)
+from .element import PipelineElement
+from .stream import (
+    DEFAULT_STREAM_ID, Frame, Stream, StreamEvent, StreamState)
+from .tensors import decode_frame_data, encode_frame_data
+
+__all__ = ["Pipeline", "RemoteElement", "create_pipeline"]
+
+_LOGGER = get_logger("pipeline")
+DEFAULT_GRACE_TIME = 60.0
+
+
+class RemoteElement:
+    """Proxy node for an element hosted by another pipeline service
+    (reference PipelineRemote, pipeline.py:1285-1319)."""
+
+    def __init__(self, pipeline, definition):
+        self.pipeline = pipeline
+        self.definition = definition
+        self.name = definition.name
+        self.ready = False
+        self.topic_path = None
+        self._pending: list[str] = []
+
+    def set_remote(self, topic_path: str) -> None:
+        self.topic_path = topic_path
+        self.ready = True
+        pending, self._pending = self._pending, []
+        for payload in pending:
+            self.pipeline.process.publish(f"{topic_path}/in", payload)
+        self.pipeline._update_lifecycle()
+
+    def set_absent(self) -> None:
+        self.ready = False
+        self.topic_path = None
+        self.pipeline._update_lifecycle()
+
+    def call(self, command: str, parameters) -> None:
+        payload = generate(command, parameters)
+        if self.ready:
+            self.pipeline.process.publish(f"{self.topic_path}/in", payload)
+        else:
+            self._pending.append(payload)
+
+
+class Pipeline(Actor):
+    def __init__(self, process, definition: PipelineDefinition,
+                 name: str = None):
+        super().__init__(process, name or definition.name,
+                         protocol=SERVICE_PROTOCOL_PIPELINE)
+        self.definition = definition
+        self.graph = validate_pipeline_definition(definition)
+        self.streams: dict[str, Stream] = {}
+        self._stream_leases: dict[str, Lease] = {}
+        self._frame_count = 0
+        self.elements: dict[str, object] = {}
+        self._services_cache: ServicesCache | None = None
+        self.share.update({
+            "definition_name": definition.name,
+            "element_count": len(definition.elements),
+            "stream_count": 0,
+            "frame_count": 0,
+        })
+        ECProducer(self)
+        self._produced_keys = self._compute_produced_keys()
+        self._create_elements()
+        self._update_lifecycle()
+
+    # -- construction ------------------------------------------------------
+
+    def _compute_produced_keys(self) -> set:
+        produced = set()
+        for element_definition in self.definition.elements:
+            for output_name in element_definition.output_names():
+                produced.add(element_definition.map_out.get(
+                    output_name, output_name))
+        return produced
+
+    def _create_elements(self) -> None:
+        for element_definition in self.definition.elements:
+            if element_definition.is_local:
+                module = load_module(element_definition.deploy_local["module"])
+                element_class = getattr(
+                    module, element_definition.deploy_local["class_name"])
+                if not issubclass(element_class, PipelineElement):
+                    raise TypeError(
+                        f"{element_definition.name}: "
+                        f"{element_class.__name__} is not a PipelineElement")
+                element = element_class(
+                    self.process, self, element_definition)
+                self.elements[element_definition.name] = element
+            else:
+                remote = RemoteElement(self, element_definition)
+                self.elements[element_definition.name] = remote
+                self._watch_remote(remote)
+
+    def _watch_remote(self, remote: RemoteElement) -> None:
+        if self._services_cache is None:
+            self._services_cache = ServicesCache(self.process)
+        service_filter = ServiceFilter(
+            **remote.definition.deploy_remote["service_filter"])
+
+        def handler(command, fields):
+            if command == "add" and not remote.ready:
+                remote.set_remote(fields.topic_path)
+            elif command == "remove" and fields.topic_path == (
+                    remote.topic_path):
+                remote.set_absent()
+
+        self._services_cache.add_handler(handler, service_filter)
+
+    def _update_lifecycle(self) -> None:
+        ready = all(
+            not isinstance(element, RemoteElement) or element.ready
+            for element in self.elements.values())
+        lifecycle = "ready" if ready else "waiting_remote"
+        if self.ec_producer is not None:
+            self.ec_producer.update("lifecycle", lifecycle)
+        else:
+            self.share["lifecycle"] = lifecycle
+
+    @property
+    def ready(self) -> bool:
+        return self.share.get("lifecycle") == "ready"
+
+    # -- stream lifecycle --------------------------------------------------
+
+    def create_stream(self, stream_id, parameters=None,
+                      grace_time=DEFAULT_GRACE_TIME, topic_response=None,
+                      queue_response=None, graph_path=None) -> Stream | None:
+        stream_id = str(stream_id)
+        if stream_id in self.streams:
+            return self.streams[stream_id]
+        try:
+            if isinstance(parameters, str):  # wire call: JSON-encoded
+                parameters = json.loads(parameters) if parameters else {}
+            if isinstance(grace_time, str):
+                grace_time = float(grace_time)
+        except ValueError as error:
+            _LOGGER.warning("%s: bad create_stream arguments: %s",
+                            self.name, error)
+            return None
+        stream = Stream(
+            stream_id=stream_id, parameters=parameters or {},
+            topic_response=topic_response or None,
+            queue_response=queue_response, graph_path=graph_path)
+        self.streams[stream_id] = stream
+        self._stream_leases[stream_id] = Lease(
+            self.process.event, grace_time, stream_id,
+            lease_expired_handler=self._stream_lease_expired)
+        # Remote streams FIRST: a local DataSource may start generating
+        # frames the moment start_stream returns, and those frames must not
+        # reach a remote pipeline before its create_stream does.
+        for node_name in self.graph.get_path():
+            element = self.elements[node_name]
+            if isinstance(element, RemoteElement):
+                element.call("create_stream", [
+                    stream_id,
+                    json.dumps(stream.parameters).encode("ascii"),
+                    grace_time,
+                    self.topic_in,
+                ])
+        for node_name in self.graph.get_path():
+            element = self.elements[node_name]
+            if not isinstance(element, RemoteElement):
+                stream_event, diagnostic = self._safe_call(
+                    element.start_stream, stream, stream_id)
+                if stream_event == StreamEvent.ERROR:
+                    _LOGGER.error("%s: start_stream failed at %s: %s",
+                                  self.name, node_name, diagnostic)
+                    self.destroy_stream(stream_id, state=StreamState.ERROR)
+                    return None
+        self._update_stream_share()
+        return stream
+
+    def destroy_stream(self, stream_id,
+                       state: StreamState = StreamState.STOP,
+                       graceful=False) -> None:
+        stream_id = str(stream_id)
+        if isinstance(state, str):  # wire call
+            state = StreamState(state)
+        if isinstance(graceful, str):
+            graceful = graceful.lower() == "true"
+        stream = self.streams.get(stream_id)
+        if stream is None:
+            return
+        if graceful and stream.pending > 0:
+            # defer until in-flight frames finish (reference graceful STOP,
+            # pipeline.py:1229-1263)
+            stream.stop_requested = True
+            return
+        self.streams.pop(stream_id, None)
+        stream.state = state
+        lease = self._stream_leases.pop(stream_id, None)
+        if lease is not None:
+            lease.terminate()
+        for node_name in self.graph.get_path():
+            element = self.elements[node_name]
+            if isinstance(element, RemoteElement):
+                element.call("destroy_stream", [stream_id])
+            else:
+                element.stop_frame_generation(stream_id)
+                self._safe_call(element.stop_stream, stream, stream_id)
+        self._update_stream_share()
+
+    def _stream_lease_expired(self, stream_id) -> None:
+        _LOGGER.info("%s: stream %s lease expired", self.name, stream_id)
+        self._stream_leases.pop(str(stream_id), None)
+        self.destroy_stream(stream_id)
+
+    # -- frame execution ---------------------------------------------------
+
+    def create_frame(self, stream: Stream, frame_data: dict) -> None:
+        """Inject a frame locally (element thread or event loop): posts onto
+        the pipeline mailbox to preserve actor ordering."""
+        stream.pending += 1
+        self.post_message(
+            "process_frame",
+            [{"stream_id": stream.stream_id, "_local": True}, frame_data])
+
+    def process_frame(self, stream_dict, frame_data=None) -> None:
+        try:
+            if isinstance(stream_dict, str):
+                stream_dict = json.loads(stream_dict)
+            if isinstance(frame_data, str):
+                frame_data = decode_frame_data(frame_data)
+        except (ValueError, KeyError) as error:
+            _LOGGER.warning("%s: undecodable frame dropped: %s",
+                            self.name, error)
+            return
+        frame_data = frame_data or {}
+        stream_id = str(stream_dict.get("stream_id", DEFAULT_STREAM_ID))
+        stream = self.streams.get(stream_id)
+        if stream is None:
+            if stream_id == DEFAULT_STREAM_ID:
+                # auto-create the default stream (reference
+                # pipeline.py:1131-1137)
+                stream = self.create_stream(stream_id)
+            if stream is None:
+                _LOGGER.debug("%s: frame for unknown stream %s dropped",
+                              self.name, stream_id)
+                return
+        lease = self._stream_leases.get(stream_id)
+        if lease is not None:
+            lease.extend()
+        frame_id = stream_dict.get("frame_id")
+        if frame_id is None:
+            frame_id = stream.frame_id
+        frame_id = int(frame_id)
+        if frame_id >= stream.frame_id:
+            stream.frame_id = frame_id + 1
+        topic_response = stream_dict.get("topic_response")
+        if topic_response:  # remote caller overrides response routing
+            stream.topic_response = topic_response
+        if not stream_dict.get("_local"):
+            stream.pending += 1
+        frame = Frame(frame_id=frame_id, swag=dict(frame_data))
+        stream.frames[frame_id] = frame
+        self._run_frame(stream, frame, resume_after=None)
+
+    def process_frame_response(self, stream_dict, frame_data=None) -> None:
+        """A remote element (hosted sub-pipeline) replied: resume the paused
+        frame after the remote node (reference pipeline.py:1156-1160)."""
+        try:
+            if isinstance(stream_dict, str):
+                stream_dict = json.loads(stream_dict)
+            if isinstance(frame_data, str):
+                frame_data = decode_frame_data(frame_data)
+        except (ValueError, KeyError) as error:
+            _LOGGER.warning("%s: undecodable frame response dropped: %s",
+                            self.name, error)
+            return
+        stream_id = str(stream_dict.get("stream_id", DEFAULT_STREAM_ID))
+        stream = self.streams.get(stream_id)
+        if stream is None:
+            _LOGGER.debug("%s: response for unknown stream %s",
+                          self.name, stream_id)
+            return
+        frame_id = int(stream_dict.get("frame_id", 0))
+        frame = stream.frames.get(frame_id)
+        if frame is None or frame.paused_pe_name is None:
+            _LOGGER.debug("%s: response for unknown frame %s/%s",
+                          self.name, stream_id, frame_id)
+            return
+        remote_event = stream_dict.get("event")
+        if remote_event:  # remote dropped/errored the frame: release it
+            frame.paused_pe_name = None
+            self._finish_frame(stream, frame, dropped=True,
+                               error=(remote_event == "error"))
+            return
+        frame.swag.update(frame_data or {})
+        resumed_node = frame.paused_pe_name
+        frame.paused_pe_name = None
+        self._run_frame(stream, frame, resume_after=resumed_node)
+
+    def _run_frame(self, stream: Stream, frame: Frame,
+                   resume_after: str | None) -> None:
+        nodes = (self.graph.get_path() if resume_after is None
+                 else self.graph.iterate_after(resume_after))
+        time_start = time.perf_counter()
+        for node_name in nodes:
+            if stream.state != StreamState.RUN:
+                break
+            element = self.elements[node_name]
+            definition = element.definition
+            try:
+                inputs = self._map_in(frame.swag, definition)
+            except KeyError as error:
+                _LOGGER.error("%s: %s missing input %s",
+                              self.name, node_name, error)
+                self._finish_frame(stream, frame, error=True)
+                return
+            if isinstance(element, RemoteElement):
+                frame.paused_pe_name = node_name
+                element.call("process_frame", [
+                    {"stream_id": stream.stream_id,
+                     "frame_id": frame.frame_id,
+                     "topic_response": self.topic_in},
+                    encode_frame_data(inputs).encode("ascii"),
+                ])
+                return  # frame stays parked in stream.frames
+            element_start = time.perf_counter()
+            stream_event, outputs = self._safe_call(
+                element.process_frame, stream, **inputs)
+            frame.metrics[f"time_{node_name}"] = (
+                frame.metrics.get(f"time_{node_name}", 0.0)
+                + time.perf_counter() - element_start)
+            if stream_event == StreamEvent.OKAY:
+                frame.swag.update(self._map_out(outputs or {}, definition))
+            elif stream_event == StreamEvent.DROP_FRAME:
+                self._finish_frame(stream, frame, dropped=True)
+                return
+            elif stream_event == StreamEvent.STOP:
+                _LOGGER.info("%s: %s requested stream stop: %s",
+                             self.name, node_name, outputs)
+                self._finish_frame(stream, frame)
+                self.destroy_stream(stream.stream_id, graceful=True)
+                return
+            else:  # ERROR or unknown
+                _LOGGER.error("%s: %s stream %s error: %s",
+                              self.name, node_name, stream.stream_id,
+                              outputs)
+                self._finish_frame(stream, frame, error=True)
+                self.destroy_stream(stream.stream_id,
+                                    state=StreamState.ERROR)
+                return
+        frame.metrics["time_pipeline"] = (
+            frame.metrics.get("time_pipeline", 0.0)
+            + time.perf_counter() - time_start)
+        self._finish_frame(stream, frame)
+
+    def _safe_call(self, method, *args, **kwargs) -> tuple:
+        try:
+            result = method(*args, **kwargs)
+            if result is None:
+                return StreamEvent.OKAY, {}
+            if (isinstance(result, tuple) and len(result) == 2
+                    and isinstance(result[0], StreamEvent)):
+                return result
+            return StreamEvent.ERROR, {
+                "diagnostic": f"{method.__qualname__} must return "
+                              f"(StreamEvent, dict), got {type(result)}"}
+        except Exception as error:
+            import traceback
+            return StreamEvent.ERROR, {
+                "diagnostic": f"{error}", "traceback": traceback.format_exc()}
+
+    def _finish_frame(self, stream: Stream, frame: Frame,
+                      dropped: bool = False, error: bool = False) -> None:
+        stream.frames.pop(frame.frame_id, None)
+        if stream.pending > 0:
+            stream.pending -= 1
+        self._frame_count += 1
+        if stream.stop_requested and stream.pending == 0:
+            self.destroy_stream(stream.stream_id)
+        if not dropped and not error:
+            self._respond(stream, frame)
+        elif stream.topic_response:
+            # A remote caller has this frame parked: notify it the frame was
+            # dropped/errored so it releases the frame instead of leaking it
+            self.process.publish(
+                stream.topic_response,
+                generate("process_frame_response", [
+                    {"stream_id": stream.stream_id,
+                     "frame_id": frame.frame_id,
+                     "event": "error" if error else "drop_frame"},
+                ]))
+
+    def _respond(self, stream: Stream, frame: Frame) -> None:
+        outputs = {key: value for key, value in frame.swag.items()
+                   if key in self._produced_keys}
+        if stream.queue_response is not None:
+            stream.queue_response.put((stream, frame, outputs))
+        elif stream.topic_response:
+            self.process.publish(
+                stream.topic_response,
+                generate("process_frame_response", [
+                    {"stream_id": stream.stream_id,
+                     "frame_id": frame.frame_id},
+                    encode_frame_data(outputs).encode("ascii"),
+                ]))
+
+    # -- name mapping (reference pipeline.py:1184-1212) --------------------
+
+    def _map_in(self, swag: dict, definition) -> dict:
+        inputs = {}
+        for port in definition.input:
+            swag_key = definition.map_in.get(port["name"], port["name"])
+            if swag_key not in swag:
+                raise KeyError(swag_key)
+            inputs[port["name"]] = swag[swag_key]
+        return inputs
+
+    def _map_out(self, outputs: dict, definition) -> dict:
+        mapped = {}
+        for port in definition.output:
+            name = port["name"]
+            if name in outputs:
+                mapped[definition.map_out.get(name, name)] = outputs[name]
+        return mapped
+
+    # -- live parameters & observability -----------------------------------
+
+    def set_parameter(self, name, value) -> None:
+        if self.ec_producer is not None:
+            self.ec_producer.update(name, value)
+        else:
+            self.share[name] = value
+
+    def set_element_parameter(self, element_name, name, value) -> None:
+        element = self.elements.get(str(element_name))
+        if element is not None and not isinstance(element, RemoteElement):
+            element.set_parameter(name, value)
+
+    def _update_stream_share(self) -> None:
+        if self.ec_producer is not None:
+            self.ec_producer.update("stream_count", len(self.streams))
+            self.ec_producer.update("frame_count", self._frame_count)
+
+    def stop(self) -> None:
+        for stream_id in list(self.streams):
+            self.destroy_stream(stream_id)
+        for element in self.elements.values():
+            if not isinstance(element, RemoteElement):
+                element.stop()
+        super().stop()
+
+
+def create_pipeline(process, definition_source, name: str = None) -> Pipeline:
+    definition = (definition_source
+                  if isinstance(definition_source, PipelineDefinition)
+                  else parse_pipeline_definition(definition_source))
+    return Pipeline(process, definition, name=name)

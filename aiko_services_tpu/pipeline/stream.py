@@ -1,0 +1,66 @@
+# Stream model: streams of frames flowing through a pipeline graph.
+#
+# Capability parity with the reference stream model (reference:
+# src/aiko_services/main/stream.py:25-98): StreamEvent return codes from
+# element process_frame calls, StreamState for the stream lifecycle, Frame as
+# the per-frame continuation (accumulated outputs in "swag", pause point for
+# remote hops, per-element metrics), and Stream as the per-stream context
+# (parameters, response routing, variables).
+#
+# TPU-first difference: swag values are arbitrary Python objects INCLUDING
+# jax.Array -- in-process element hand-off is a dict insert, never a
+# serialization (SURVEY.md section 2.4).  Stream context is always passed
+# explicitly; there is no thread-local stream state (reference
+# pipeline.py:584-610 is a design smell SURVEY.md section 7 says to drop).
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+__all__ = ["StreamEvent", "StreamState", "Frame", "Stream",
+           "DEFAULT_STREAM_ID", "FIRST_FRAME_ID"]
+
+DEFAULT_STREAM_ID = "*"   # reference stream.py:30
+FIRST_FRAME_ID = 0        # reference stream.py:31
+
+
+class StreamEvent(Enum):
+    OKAY = "okay"
+    STOP = "stop"
+    ERROR = "error"
+    DROP_FRAME = "drop_frame"
+    USER = "user"
+
+
+class StreamState(Enum):
+    RUN = "run"
+    STOP = "stop"
+    ERROR = "error"
+    DROP_FRAME = "drop_frame"
+
+
+@dataclass
+class Frame:
+    frame_id: int = FIRST_FRAME_ID
+    swag: dict = field(default_factory=dict)
+    metrics: dict = field(default_factory=dict)
+    paused_pe_name: str | None = None
+
+
+@dataclass
+class Stream:
+    stream_id: str = DEFAULT_STREAM_ID
+    frame_id: int = FIRST_FRAME_ID          # next frame id to assign
+    graph_path: str | None = None
+    frames: dict = field(default_factory=dict)   # frame_id -> Frame
+    parameters: dict = field(default_factory=dict)
+    queue_response: object = None
+    topic_response: str | None = None
+    state: StreamState = StreamState.RUN
+    variables: dict = field(default_factory=dict)  # per-element stream state
+    pending: int = 0    # frames posted but not yet finished (backpressure)
+    stop_requested: bool = False   # graceful stop: destroy when pending==0
+
+    def to_dict(self) -> dict:
+        return {"stream_id": self.stream_id, "frame_id": self.frame_id}

@@ -1,0 +1,71 @@
+# Tensor-aware frame-data codec for CROSS-PROCESS hops only.
+#
+# In-process, swag values (including jax.Array) pass by reference and never
+# touch this codec.  When a frame crosses a process boundary, values are
+# JSON-encoded with numpy/jax arrays carried as base64 .npy blobs -- a
+# binary-safe, self-describing replacement for the reference's ad-hoc
+# base64/zlib user elements (reference: PE_DataEncode/Decode,
+# src/aiko_services/examples/pipeline/elements.py:298-324, and audio
+# PE_RemoteSend, elements/media/audio_io.py:520-560).  Large-tensor
+# cross-host transfer over ICI/DCN bypasses this path entirely (the mesh
+# data plane in parallel/).
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import zlib
+
+import numpy as np
+
+__all__ = ["encode_frame_data", "decode_frame_data", "encode_value",
+           "decode_value"]
+
+_NDARRAY_KEY = "__ndarray__"
+_COMPRESS_THRESHOLD_BYTES = 4096
+
+
+def encode_value(value):
+    if hasattr(value, "__array__") and not isinstance(
+            value, (bool, int, float, str, list, tuple, dict)):
+        array = np.asarray(value)
+        buffer = io.BytesIO()
+        np.save(buffer, array, allow_pickle=False)
+        raw = buffer.getvalue()
+        compressed = len(raw) >= _COMPRESS_THRESHOLD_BYTES
+        if compressed:
+            raw = zlib.compress(raw, level=1)
+        return {_NDARRAY_KEY: {
+            "z": compressed,
+            "data": base64.b64encode(raw).decode("ascii")}}
+    if isinstance(value, dict):
+        return {key: encode_value(item) for key, item in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [encode_value(item) for item in value]
+    return value
+
+
+def decode_value(value):
+    if isinstance(value, dict):
+        if _NDARRAY_KEY in value:
+            record = value[_NDARRAY_KEY]
+            raw = base64.b64decode(record["data"])
+            if record.get("z"):
+                raw = zlib.decompress(raw)
+            return np.load(io.BytesIO(raw), allow_pickle=False)
+        return {key: decode_value(item) for key, item in value.items()}
+    if isinstance(value, list):
+        return [decode_value(item) for item in value]
+    return value
+
+
+def encode_frame_data(frame_data: dict) -> str:
+    return json.dumps(
+        {key: encode_value(value) for key, value in frame_data.items()},
+        separators=(",", ":"))
+
+
+def decode_frame_data(text: str) -> dict:
+    return {key: decode_value(value)
+            for key, value in json.loads(text).items()}

@@ -1,0 +1,13 @@
+from .event import EventEngine, Mailbox                       # noqa: F401
+from .lease import Lease                                      # noqa: F401
+from .connection import Connection, ConnectionState           # noqa: F401
+from .service import (                                        # noqa: F401
+    Service, ServiceProtocol, ServiceFields, ServiceFilter, ServiceTags,
+    ServiceTopicPath, Services, PROTOCOL_PREFIX,
+    SERVICE_PROTOCOL_REGISTRAR, SERVICE_PROTOCOL_PIPELINE,
+    SERVICE_PROTOCOL_ACTOR)
+from .process import Process, default_process                 # noqa: F401
+from .actor import Actor, ActorMessage, ActorTopic            # noqa: F401
+from .proxy import make_proxy, get_public_methods, RemoteProxy  # noqa: F401
+from .share import ECProducer, ECConsumer, ServicesCache      # noqa: F401
+from .registrar import Registrar                              # noqa: F401

@@ -1,0 +1,54 @@
+# Remote service proxies: call a remote actor's methods as if local.
+#
+# Capability parity with the reference remote-proxy maker (reference:
+# src/aiko_services/main/transport/transport_mqtt.py:109-141): reflect the
+# public methods of an interface class and build an object whose every method
+# publishes "(method arg ...)" to the target's "{topic_path}/in".
+
+from __future__ import annotations
+
+from ..utils import generate
+
+__all__ = ["get_public_methods", "make_proxy", "RemoteProxy"]
+
+
+def get_public_methods(interface_class) -> list[str]:
+    return sorted(
+        name for name in dir(interface_class)
+        if not name.startswith("_")
+        and callable(getattr(interface_class, name)))
+
+
+class RemoteProxy:
+    """Dynamic proxy: attribute access returns a publisher for any method
+    name; an optional interface class restricts the surface."""
+
+    def __init__(self, process, topic_in: str, interface_class=None):
+        object.__setattr__(self, "_process", process)
+        object.__setattr__(self, "_topic_in", topic_in)
+        methods = (set(get_public_methods(interface_class))
+                   if interface_class is not None else None)
+        object.__setattr__(self, "_methods", methods)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._methods is not None and name not in self._methods:
+            raise AttributeError(
+                f"{name} is not part of the proxied interface")
+
+        def remote_call(*args):
+            self._process.publish(self._topic_in, generate(name, args))
+
+        remote_call.__name__ = name
+        return remote_call
+
+    def __repr__(self):
+        return f"RemoteProxy({self._topic_in})"
+
+
+def make_proxy(process, topic_path: str, interface_class=None) -> RemoteProxy:
+    """topic_path may be the service root or the /in topic itself."""
+    topic_in = (topic_path if topic_path.endswith("/in")
+                else f"{topic_path}/in")
+    return RemoteProxy(process, topic_in, interface_class)

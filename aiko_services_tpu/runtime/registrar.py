@@ -1,0 +1,194 @@
+# Registrar: the service-discovery directory with primary election.
+#
+# Capability parity with the reference registrar (reference:
+# src/aiko_services/main/registrar.py:34-357): election via the retained
+# bootstrap topic "{namespace}/service/registrar" (start -> primary_search ->
+# primary | secondary, promotion after a search timeout, failover when the
+# primary's LWT "(primary absent)" fires); a service table fed by "(add ...)"
+# / "(remove ...)" commands; death reaping from "(absent)" state notices;
+# "(share response_topic filter...)" queries; and a bounded history ring.
+#
+# Split-brain fix (SURVEY.md section 7 hard part 6): the reference admits a
+# multi-secondary election bug (reference registrar.py:54-55); here a primary
+# that sees another primary's retained announcement with an EARLIER timestamp
+# deterministically demotes itself.
+
+from __future__ import annotations
+
+from collections import deque
+
+from ..utils import generate, parse, parse_float, get_logger, epoch_now
+from .actor import Actor
+from .service import (
+    ServiceFields, ServiceFilter, Services, SERVICE_PROTOCOL_REGISTRAR)
+from .share import ECProducer
+
+__all__ = ["Registrar"]
+
+_LOGGER = get_logger("registrar")
+_HISTORY_RING_SIZE = 4096  # reference registrar.py:128-129
+DEFAULT_SEARCH_TIMEOUT = 2.0  # reference registrar.py:139-141
+
+
+class Registrar(Actor):
+    def __init__(self, process, name: str = "registrar",
+                 search_timeout: float = DEFAULT_SEARCH_TIMEOUT):
+        super().__init__(process, name,
+                         protocol=SERVICE_PROTOCOL_REGISTRAR)
+        self.search_timeout = search_timeout
+        self.command_aliases["share"] = "share_query"
+        self.state = "start"
+        self.time_started = epoch_now()
+        self.services_table = Services()
+        self.history_ring: deque = deque(maxlen=_HISTORY_RING_SIZE)
+        self.share.update({
+            "state": self.state,
+            "service_count": 0,
+            "time_started": repr(self.time_started),
+        })
+        ECProducer(self)
+
+        self._boot_topic = process.topic_path_registrar_boot
+        self._state_pattern = f"{process.namespace}/+/+/+/state"
+        process.add_message_handler(self._boot_handler, self._boot_topic)
+        self._transition("primary_search")
+        process.event.add_timer_handler(
+            self._search_timer, self.search_timeout)
+
+    # -- election ----------------------------------------------------------
+
+    def _transition(self, state: str) -> None:
+        self.state = state
+        if self.ec_producer:
+            self.ec_producer.update("state", state)
+        _LOGGER.debug("%s: state -> %s", self.topic_path, state)
+
+    def _search_timer(self) -> None:
+        self.process.event.remove_timer_handler(self._search_timer)
+        if self.state == "primary_search":
+            self._promote_to_primary()
+
+    def _boot_handler(self, topic: str, payload: str) -> None:
+        try:
+            command, parameters = parse(payload)
+        except ValueError:
+            return
+        if command != "primary" or not parameters:
+            return
+        if parameters[0] == "found":
+            found_topic = parameters[1] if len(parameters) > 1 else ""
+            found_time = parse_float(
+                parameters[3] if len(parameters) > 3 else "0")
+            if found_topic == self.topic_path:
+                return
+            if self.state == "primary":
+                loses_tie = (found_time == self.time_started
+                             and found_topic < self.topic_path)
+                if found_time and (found_time < self.time_started
+                                   or loses_tie):
+                    _LOGGER.warning(
+                        "%s: older primary %s found, demoting",
+                        self.topic_path, found_topic)
+                    self._demote_to_secondary()
+                else:
+                    # re-assert: we are the older primary
+                    self.process.announce_registrar(self.topic_path)
+            elif self.state in ("primary_search", "secondary"):
+                self._transition("secondary")
+        elif parameters[0] == "absent":
+            if self.state == "secondary":
+                self._transition("primary_search")
+                self.process.event.add_timer_handler(
+                    self._search_timer, self.search_timeout * 0.5)
+
+    def _promote_to_primary(self) -> None:
+        self.time_started = epoch_now()
+        self._transition("primary")
+        transport = self.process.transport
+        transport.set_last_will_and_testament(
+            self._boot_topic, "(primary absent)", retain=True)
+        self.process.add_message_handler(
+            self._service_state_handler, self._state_pattern)
+        self.process.announce_registrar(self.topic_path)
+
+    def _demote_to_secondary(self) -> None:
+        self._transition("secondary")
+        self.process.transport.clear_last_will_and_testament(
+            self._boot_topic)
+        self.process.remove_message_handler(
+            self._service_state_handler, self._state_pattern)
+        self.services_table = Services()
+        self._update_service_count()
+
+    # -- service table commands (arrive via actor mailbox on /in) ----------
+
+    def add(self, topic_path, name, protocol, transport, owner, tags=None):
+        if self.state != "primary":
+            return
+        fields = ServiceFields(topic_path, name, protocol, transport, owner,
+                               tags if isinstance(tags, list) else [tags])
+        self.services_table.add_service(fields)
+        self.history_ring.append(("add", fields, epoch_now()))
+        self._update_service_count()
+        self.publish_out("add", fields.to_parameters())
+
+    def remove(self, topic_path):
+        if self.state != "primary":
+            return
+        removed = self.services_table.remove_service(topic_path)
+        for fields in removed:
+            self.history_ring.append(("remove", fields, epoch_now()))
+            self.publish_out("remove", [fields.topic_path])
+        if removed:
+            self._update_service_count()
+
+    def share_query(self, response_topic, topic_paths="*", name="*",
+                    protocol="*", transport="*", owner="*", tags="*"):
+        service_filter = ServiceFilter(topic_paths, name, protocol,
+                                       transport, owner, tags)
+        matches = self.services_table.filter_services(service_filter)
+        publish = self.process.publish
+        publish(response_topic, generate("item_count", [len(matches)]))
+        for fields in matches:
+            publish(response_topic, generate("add", fields.to_parameters()))
+        publish(response_topic, generate("sync", [self.topic_path]))
+
+    def history(self, response_topic, count="16"):
+        count = int(parse_float(count, 16))
+        entries = list(self.history_ring)[-count:]
+        publish = self.process.publish
+        publish(response_topic, generate("item_count", [len(entries)]))
+        for command, fields, timestamp in entries:
+            publish(response_topic,
+                    generate("history",
+                             [command, repr(timestamp)]
+                             + fields.to_parameters()))
+
+    # -- death reaping -----------------------------------------------------
+
+    def _service_state_handler(self, topic: str, payload: str) -> None:
+        try:
+            command, _ = parse(payload)
+        except ValueError:
+            return
+        if command != "absent":
+            return
+        service_topic_path = topic.rsplit("/state", 1)[0]
+        self.remove(service_topic_path)
+
+    def _update_service_count(self) -> None:
+        if self.ec_producer:
+            self.ec_producer.update(
+                "service_count", len(self.services_table))
+
+    def stop(self) -> None:
+        if self.state == "primary":
+            # clean handover: clear the retained announcement
+            self.process.publish(self._boot_topic, "(primary absent)",
+                                 retain=True)
+        self.process.remove_message_handler(self._boot_handler,
+                                            self._boot_topic)
+        if self.state == "primary":
+            self.process.remove_message_handler(
+                self._service_state_handler, self._state_pattern)
+        super().stop()

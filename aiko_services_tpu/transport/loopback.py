@@ -1,0 +1,217 @@
+# In-process loopback broker: the hermetic default transport.
+#
+# The reference framework required a live mosquitto broker for every test and
+# offered only a no-op "Castaway" fallback (reference:
+# src/aiko_services/main/message/castaway.py:9-44) -- SURVEY.md section 4
+# identifies the missing in-memory broker as the key testing gap.  This
+# broker provides real MQTT semantics in-process: wildcard subscriptions,
+# retained messages, and last-will-and-testament delivery on unclean
+# disconnect, with deliveries dispatched from a dedicated broker thread so
+# publish() never runs subscriber code inline (mirroring the paho network
+# thread boundary, reference mqtt.py:125-127).
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+
+from .base import Transport, topic_matches
+
+__all__ = ["LoopbackBroker", "LoopbackTransport", "get_broker", "reset_brokers"]
+
+_BROKERS: dict[str, "LoopbackBroker"] = {}
+_BROKERS_LOCK = threading.Lock()
+
+
+def get_broker(name: str = "default") -> "LoopbackBroker":
+    with _BROKERS_LOCK:
+        broker = _BROKERS.get(name)
+        if broker is None:
+            broker = _BROKERS[name] = LoopbackBroker(name)
+        return broker
+
+
+def reset_brokers() -> None:
+    """Tear down all brokers (test isolation)."""
+    with _BROKERS_LOCK:
+        brokers = list(_BROKERS.values())
+        _BROKERS.clear()
+    for broker in brokers:
+        broker.shutdown()
+
+
+class LoopbackBroker:
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._clients: list[LoopbackTransport] = []
+        self._retained: dict[str, str] = {}
+        self._queue: queue.Queue = queue.Queue()
+        self._alive = True
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name=f"loopback-{name}", daemon=True)
+        self._thread.start()
+
+    # -- client management -------------------------------------------------
+
+    def attach(self, client: "LoopbackTransport") -> None:
+        with self._lock:
+            if client not in self._clients:
+                self._clients.append(client)
+
+    def detach(self, client: "LoopbackTransport", send_lwt: bool) -> None:
+        with self._lock:
+            if client in self._clients:
+                self._clients.remove(client)
+        if send_lwt:
+            for topic, (payload, retain) in list(client.wills.items()):
+                self.publish(topic, payload, retain=retain)
+
+    # -- pub/sub -----------------------------------------------------------
+
+    def publish(self, topic: str, payload, retain: bool = False) -> None:
+        payload = _to_text(payload)
+        if retain:
+            with self._lock:
+                if payload == "":
+                    self._retained.pop(topic, None)  # MQTT clears on empty
+                else:
+                    self._retained[topic] = payload
+        self._queue.put(("publish", topic, payload))
+
+    def deliver_retained(self, client: "LoopbackTransport",
+                         pattern: str) -> None:
+        with self._lock:
+            matches = [(topic, payload)
+                       for topic, payload in self._retained.items()
+                       if topic_matches(pattern, topic)]
+        for topic, payload in matches:
+            self._queue.put(("retained", topic, payload, client))
+
+    def retained(self, topic: str):
+        with self._lock:
+            return self._retained.get(topic)
+
+    # -- dispatch thread ---------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            if item[0] == "publish":
+                _, topic, payload = item
+                with self._lock:
+                    clients = list(self._clients)
+                for client in clients:
+                    client._maybe_deliver(topic, payload)
+            else:  # retained delivery to one client
+                _, topic, payload, client = item
+                client._deliver(topic, payload)
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Block until every queued delivery has been dispatched (tests)."""
+        done = threading.Event()
+        self._queue.put(("retained", None, None, _Sentinel(done)))
+        done.wait(timeout)
+
+    def shutdown(self) -> None:
+        if self._alive:
+            self._alive = False
+            self._queue.put(None)
+            self._thread.join(timeout=2)
+
+
+class _Sentinel:
+    def __init__(self, event):
+        self._event = event
+
+    def _deliver(self, topic, payload):
+        self._event.set()
+
+
+def _to_text(payload) -> str:
+    if payload is None:
+        return ""
+    if isinstance(payload, bytes):
+        return payload.decode("latin-1")
+    return str(payload)
+
+
+class LoopbackTransport(Transport):
+    _ids = itertools.count()
+
+    def __init__(self, on_message=None, broker: str = "default"):
+        super().__init__(on_message)
+        self._broker_name = broker
+        self._broker: LoopbackBroker | None = None
+        self._subscriptions: set[str] = set()
+        self._lock = threading.Lock()
+        self._connected = False
+        self.client_id = next(self._ids)
+        # Unlike MQTT's single will per connection, the loopback broker
+        # supports one will PER TOPIC so a process-liveness will and a
+        # registrar-election will can coexist in one process.
+        self.wills: dict[str, tuple[str, bool]] = {}
+
+    def connect(self) -> None:
+        self._broker = get_broker(self._broker_name)
+        self._broker.attach(self)
+        self._connected = True
+        with self._lock:
+            patterns = list(self._subscriptions)
+        for pattern in patterns:
+            self._broker.deliver_retained(self, pattern)
+
+    def disconnect(self, send_lwt: bool = False) -> None:
+        if self._broker is not None:
+            self._broker.detach(self, send_lwt)
+        self._connected = False
+
+    def publish(self, topic: str, payload, retain: bool = False) -> None:
+        if self._broker is None:
+            raise RuntimeError("LoopbackTransport not connected")
+        self._broker.publish(topic, payload, retain)
+
+    def subscribe(self, topic: str) -> None:
+        with self._lock:
+            if topic in self._subscriptions:
+                return
+            self._subscriptions.add(topic)
+        if self._broker is not None and self._connected:
+            self._broker.deliver_retained(self, topic)
+
+    def unsubscribe(self, topic: str) -> None:
+        with self._lock:
+            self._subscriptions.discard(topic)
+
+    def set_last_will_and_testament(
+            self, topic: str, payload, retain: bool = False) -> None:
+        self.wills[topic] = (_to_text(payload), retain)
+
+    def clear_last_will_and_testament(self, topic: str) -> None:
+        self.wills.pop(topic, None)
+
+    @property
+    def connected(self) -> bool:
+        return self._connected
+
+    # -- broker-side delivery (broker dispatch thread) ---------------------
+
+    def _maybe_deliver(self, topic: str, payload: str) -> None:
+        if not self._connected:
+            return
+        with self._lock:
+            matched = any(topic_matches(pattern, topic)
+                          for pattern in self._subscriptions)
+        if matched:
+            self._deliver(topic, payload)
+
+    def _deliver(self, topic: str, payload: str) -> None:
+        if self.on_message is not None:
+            try:
+                self.on_message(topic, payload)
+            except Exception:  # broker thread must survive handler errors
+                import traceback
+                traceback.print_exc()

@@ -1,0 +1,12 @@
+from .sexpr import (                                        # noqa: F401
+    generate, parse, parse_list_to_dict, parse_int, parse_float,
+    parse_number, ParseError)
+from .graph import Graph, Node, GraphError                  # noqa: F401
+from .config import (                                       # noqa: F401
+    get_namespace, get_hostname, get_pid, get_transport_configuration,
+    get_mqtt_configuration, get_bool_env)
+from .lru_cache import LRUCache                             # noqa: F401
+from .timeutil import (                                     # noqa: F401
+    epoch_now, epoch_to_iso, iso_to_epoch, monotonic)
+from .logger import get_logger, RingBufferHandler           # noqa: F401
+from .importer import load_module                           # noqa: F401

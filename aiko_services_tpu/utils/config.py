@@ -1,0 +1,68 @@
+# Environment configuration.
+#
+# Capability parity with the reference configuration module (reference:
+# src/aiko_services/main/utilities/configuration.py:91-186): namespace, host
+# identity, and transport endpoint come from AIKO_* environment variables with
+# sane localhost defaults.  The TPU framework adds mesh/topology variables and
+# defaults the transport to the in-process loopback broker so broker-less
+# hermetic runs are the default rather than a fallback.
+
+from __future__ import annotations
+
+import os
+import socket
+
+__all__ = [
+    "get_namespace", "get_hostname", "get_pid", "get_transport_configuration",
+    "get_mqtt_configuration", "get_bool_env",
+]
+
+DEFAULT_NAMESPACE = "aiko"
+
+
+def get_namespace() -> str:
+    return os.environ.get("AIKO_NAMESPACE", DEFAULT_NAMESPACE)
+
+
+def get_hostname() -> str:
+    hostname = os.environ.get("AIKO_HOSTNAME")
+    if hostname:
+        return hostname
+    return socket.gethostname().split(".")[0].lower()
+
+
+def get_pid() -> str:
+    return str(os.getpid())
+
+
+def get_bool_env(name: str, default: bool = False) -> bool:
+    value = os.environ.get(name)
+    if value is None:
+        return default
+    return value.strip().lower() in ("1", "true", "yes", "on", "all")
+
+
+def get_mqtt_configuration() -> dict:
+    """MQTT endpoint settings (reference configuration.py:101-114)."""
+    return {
+        "host": os.environ.get("AIKO_MQTT_HOST", "localhost"),
+        "port": int(os.environ.get("AIKO_MQTT_PORT", "1883")),
+        "transport": os.environ.get("AIKO_MQTT_TRANSPORT", "tcp"),
+        "username": os.environ.get("AIKO_USERNAME"),
+        "password": os.environ.get("AIKO_PASSWORD"),
+        "tls": get_bool_env("AIKO_MQTT_TLS"),
+    }
+
+
+def get_transport_configuration() -> dict:
+    """Which control-plane transport to use.
+
+    AIKO_TRANSPORT = loopback (default) | mqtt | null.  The loopback broker
+    gives full pub/sub + retained + LWT semantics in-process, so the whole
+    control plane runs hermetically; MQTT is opt-in when a real broker and
+    paho-mqtt are available.
+    """
+    return {
+        "kind": os.environ.get("AIKO_TRANSPORT", "loopback"),
+        "mqtt": get_mqtt_configuration(),
+    }

@@ -1,0 +1,70 @@
+# Logging: console always; distributed log publishing is layered on by the
+# runtime (a transport handler that forwards records to "{topic_path}/log",
+# see runtime/process.py), giving capability parity with the reference's
+# LoggingHandlerMQTT ring-buffer design (reference:
+# src/aiko_services/main/utilities/logger.py:98-172) without binding the
+# utility layer to any transport.
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import deque
+
+__all__ = ["get_logger", "RingBufferHandler", "DEFAULT_LOG_FORMAT"]
+
+DEFAULT_LOG_FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+
+
+def get_logger(name: str, level: str | None = None) -> logging.Logger:
+    """Per-subsystem logger; level from AIKO_LOG_LEVEL_<NAME> then
+    AIKO_LOG_LEVEL then INFO (reference logger.py:98-118)."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(DEFAULT_LOG_FORMAT))
+        logger.addHandler(handler)
+        logger.propagate = False
+    env_level = (level
+                 or os.environ.get(f"AIKO_LOG_LEVEL_{name.upper()}")
+                 or os.environ.get("AIKO_LOG_LEVEL")
+                 or "INFO")
+    logger.setLevel(env_level.upper())
+    return logger
+
+
+class RingBufferHandler(logging.Handler):
+    """Buffers records until a sink is attached, then streams through it.
+
+    The runtime attaches a sink that publishes to the service's /log topic
+    once the transport connects, flushing the buffered backlog first --
+    the same connect-then-flush behavior as the reference's MQTT handler
+    (reference logger.py:137-145), transport-agnostic here.
+    """
+
+    def __init__(self, capacity: int = 128):
+        super().__init__()
+        self._ring = deque(maxlen=capacity)
+        self._sink = None
+        self.setFormatter(logging.Formatter(DEFAULT_LOG_FORMAT))
+
+    def attach_sink(self, sink) -> None:
+        self._sink = sink
+        while self._ring:
+            self._emit_to_sink(self._ring.popleft())
+
+    def detach_sink(self) -> None:
+        self._sink = None
+
+    def _emit_to_sink(self, text: str) -> None:
+        try:
+            self._sink(text)
+        except Exception:  # logging must never take the process down
+            pass
+
+    def emit(self, record: logging.LogRecord) -> None:
+        text = self.format(record)
+        if self._sink is None:
+            self._ring.append(text)
+        else:
+            self._emit_to_sink(text)

@@ -1,0 +1,11 @@
+# Test configuration: force JAX onto a virtual 8-device CPU mesh BEFORE jax
+# is imported anywhere, so sharding/collective tests run without TPU hardware.
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("AIKO_NAMESPACE", "aiko_test")

@@ -1,0 +1,12 @@
+import time
+
+
+def wait_for(predicate, timeout: float = 5.0, interval: float = 0.002):
+    """Poll until predicate() is truthy; return its value or raise."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise TimeoutError(f"Condition not met within {timeout}s: {predicate}")

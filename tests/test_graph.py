@@ -1,0 +1,69 @@
+import pytest
+
+from aiko_services_tpu.utils import Graph, GraphError
+
+
+def test_linear_path():
+    graph = Graph.traverse(["(PE_0 PE_1 PE_2)"])
+    # PE_0 fans out to PE_1 and PE_2 (both direct successors)
+    assert graph.get_node("PE_0").successors == ["PE_1", "PE_2"]
+
+
+def test_chain():
+    graph = Graph.traverse(["(PE_0 (PE_1 (PE_2 PE_3)))"])
+    assert graph.get_path() == ["PE_0", "PE_1", "PE_2", "PE_3"]
+
+
+def test_diamond():
+    graph = Graph.traverse(["(PE_0 (PE_1 PE_3) (PE_2 PE_3))"])
+    order = graph.get_path()
+    assert order[0] == "PE_0"
+    assert order[-1] == "PE_3"
+    assert set(order) == {"PE_0", "PE_1", "PE_2", "PE_3"}
+    assert order.index("PE_1") < order.index("PE_3")
+    assert order.index("PE_2") < order.index("PE_3")
+    assert graph.predecessors("PE_3") == ["PE_1", "PE_2"]
+
+
+def test_iterate_after():
+    graph = Graph.traverse(["(PE_0 (PE_1 PE_3) (PE_2 PE_3))"])
+    order = graph.get_path()
+    resumed = graph.iterate_after(order[1])
+    assert resumed == order[2:]
+    assert graph.iterate_after(order[-1]) == []
+
+
+def test_iterate_after_unknown_raises():
+    graph = Graph.traverse(["(A B)"])
+    with pytest.raises(GraphError):
+        graph.iterate_after("ZZZ")
+
+
+def test_cycle_detected():
+    graph = Graph.traverse(["(A B)"])
+    graph.get_node("B").add_successor("A")
+    graph._order_cache = None
+    with pytest.raises(GraphError):
+        graph.topological_order()
+
+
+def test_multiple_paths():
+    graph = Graph.traverse(["(A B)", "(C B)"])
+    assert set(graph.head_nodes()) == {"A", "C"}
+    order = graph.get_path()
+    assert order.index("A") < order.index("B")
+    assert order.index("C") < order.index("B")
+
+
+def test_deterministic_order():
+    orders = [
+        Graph.traverse(["(PE_0 (PE_1 PE_3) (PE_2 PE_3))"]).get_path()
+        for _ in range(5)]
+    assert all(order == orders[0] for order in orders)
+
+
+def test_remote_annotation():
+    graph = Graph.traverse(["(A B:remote_x)"])
+    assert "B" in graph
+    node = graph.get_node("B")
+    assert node.properties["remote_paths"] == ["B:remote_x"]

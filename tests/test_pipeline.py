@@ -1,0 +1,320 @@
+import queue
+
+import pytest
+
+from aiko_services_tpu.pipeline import (
+    DefinitionError, StreamState, create_pipeline, parse_pipeline_definition)
+from aiko_services_tpu.runtime import Process, Registrar
+from aiko_services_tpu.transport import reset_brokers
+from helpers import wait_for
+
+ELEMENTS = "aiko_services_tpu.elements"
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+def local(class_name):
+    return {"local": {"module": ELEMENTS, "class_name": class_name}}
+
+
+def text_pipeline_definition(items, transform="upper"):
+    return {
+        "name": "text_pipeline",
+        "graph": ["(source (transform output))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "text", "type": "str"}],
+             "parameters": {"data_sources": items},
+             "deploy": local("TextSource")},
+            {"name": "transform",
+             "input": [{"name": "text", "type": "str"}],
+             "output": [{"name": "text", "type": "str"}],
+             "parameters": {"transform": transform},
+             "deploy": local("TextTransform")},
+            {"name": "output",
+             "input": [{"name": "text", "type": "str"}],
+             "output": [{"name": "text", "type": "str"}],
+             "deploy": local("TextOutput")},
+        ],
+    }
+
+
+def drain(response_queue, count, timeout=5.0):
+    results = []
+    for _ in range(count):
+        results.append(response_queue.get(timeout=timeout))
+    return results
+
+
+def test_definition_validation_rejects_unlinked_input():
+    definition = text_pipeline_definition(["x"])
+    definition["elements"][1]["input"] = [{"name": "nope", "type": "str"}]
+    with pytest.raises(DefinitionError, match="nope"):
+        parse_pipeline_definition(definition)
+
+
+def test_definition_validation_rejects_unknown_node():
+    definition = text_pipeline_definition(["x"])
+    definition["graph"] = ["(source (transform missing_node))"]
+    with pytest.raises(DefinitionError, match="missing_node"):
+        parse_pipeline_definition(definition)
+
+
+def test_text_pipeline_end_to_end_single_frame():
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, text_pipeline_definition(["hello"]))
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    stream, frame, outputs = responses.get(timeout=5)
+    assert outputs["text"] == "HELLO"
+    assert frame.metrics["time_pipeline"] > 0
+    assert "time_transform" in frame.metrics
+    process.terminate()
+
+
+def test_text_pipeline_multiple_frames_via_generator():
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(
+        process, text_pipeline_definition(["a", "b", "c"], "upper"))
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    results = drain(responses, 3)
+    texts = sorted(outputs["text"] for _, _, outputs in results)
+    assert texts == ["A", "B", "C"]
+    # generator exhaustion destroys the stream
+    wait_for(lambda: "s1" not in pipeline.streams)
+    process.terminate()
+
+
+def test_diamond_fanout_fanin_with_mapping():
+    definition = {
+        "name": "diamond",
+        "graph": ["(source (add_a join) (add_b join))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [10]},
+             "deploy": local("PE_Number")},
+            {"name": "add_a", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "map_out": {"number": "number_a"},
+             "parameters": {"constant": 1},
+             "deploy": local("PE_Add")},
+            {"name": "add_b", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "map_out": {"number": "number_b"},
+             "parameters": {"constant": 100},
+             "deploy": local("PE_Add")},
+            {"name": "join", "input": [{"name": "a"}, {"name": "b"}],
+             "output": [{"name": "number"}],
+             "map_in": {"a": "number_a", "b": "number_b"},
+             "deploy": local("PE_Sum2")},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    _, _, outputs = responses.get(timeout=5)
+    assert outputs["number"] == (10 + 1) + (10 + 100)
+    process.terminate()
+
+
+def test_drop_frame_skips_rest_of_graph():
+    definition = {
+        "name": "sampled",
+        "graph": ["(source (sample output))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "text"}],
+             "parameters": {"data_sources": ["a", "b", "c", "d"],
+                            "rate": 200},
+             "deploy": local("TextSource")},
+            {"name": "sample", "input": [{"name": "text"}],
+             "output": [{"name": "text"}],
+             "parameters": {"sample_rate": 2},
+             "deploy": local("TextSample")},
+            {"name": "output", "input": [{"name": "text"}],
+             "output": [{"name": "text"}],
+             "deploy": local("TextOutput")},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    results = drain(responses, 2)
+    texts = sorted(outputs["text"] for _, _, outputs in results)
+    assert texts == ["a", "c"]  # every 2nd frame dropped
+    process.terminate()
+
+
+def test_element_error_destroys_stream():
+    definition = text_pipeline_definition(["x"], transform="EXPLODE")
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    wait_for(lambda: "s1" not in pipeline.streams)
+    assert responses.empty()
+    process.terminate()
+
+
+def test_parameter_resolution_order():
+    process = Process(transport_kind="loopback")
+    definition = text_pipeline_definition(["x"])
+    definition["parameters"] = {"transform": "lower"}   # pipeline level
+    del definition["elements"][1]["parameters"]["transform"]
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+
+    # pipeline-level parameter applies
+    pipeline.create_stream("s1", queue_response=responses)
+    _, _, outputs = responses.get(timeout=5)
+    assert outputs["text"] == "x"
+
+    # stream-level parameter overrides pipeline level
+    pipeline.create_stream("s2", parameters={"transform": "upper"},
+                           queue_response=responses)
+    _, _, outputs = responses.get(timeout=5)
+    assert outputs["text"] == "X"
+
+    # element-scoped stream parameter wins over bare stream parameter
+    pipeline.create_stream(
+        "s3", parameters={"transform": "upper",
+                          "transform.transform": "title"},
+        queue_response=responses)
+    _, _, outputs = responses.get(timeout=5)
+    assert outputs["text"] == "X"  # scoped key is "transform.transform"
+    process.terminate()
+
+
+def test_default_stream_auto_created():
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, text_pipeline_definition(["seed"]))
+    process.run(in_thread=True)
+    # inject a frame for the "*" stream without create_stream
+    pipeline.process_frame({"stream_id": "*"}, {"text": "direct"})
+    wait_for(lambda: "*" in pipeline.streams)
+    process.terminate()
+
+
+def test_remote_element_pause_resume():
+    registrar_process = Process(transport_kind="loopback")
+    Registrar(registrar_process, search_timeout=0.05)
+    registrar_process.run(in_thread=True)
+
+    remote_definition = {
+        "name": "pipeline_b",
+        "graph": ["(add)"],
+        "elements": [
+            {"name": "add", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "parameters": {"constant": 5},
+             "deploy": local("PE_Add")},
+        ],
+    }
+    process_b = Process(transport_kind="loopback")
+    create_pipeline(process_b, remote_definition)
+    process_b.run(in_thread=True)
+
+    local_definition = {
+        "name": "pipeline_a",
+        "graph": ["(source (remote_add (double)))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [7]},
+             "deploy": local("PE_Number")},
+            {"name": "remote_add", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "deploy": {"remote": {
+                 "service_filter": {"name": "pipeline_b"}}}},
+            {"name": "double", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "parameters": {"constant": 2},
+             "deploy": local("PE_Multiply")},
+        ],
+    }
+    process_a = Process(transport_kind="loopback")
+    pipeline_a = create_pipeline(process_a, local_definition)
+    process_a.run(in_thread=True)
+    wait_for(lambda: pipeline_a.ready, timeout=10)
+
+    responses = queue.Queue()
+    pipeline_a.create_stream("s1", queue_response=responses)
+    _, frame, outputs = responses.get(timeout=10)
+    assert outputs["number"] == (7 + 5) * 2
+    assert frame.paused_pe_name is None
+
+    for process in (registrar_process, process_b, process_a):
+        process.terminate()
+
+
+def test_remote_drop_frame_releases_parked_parent_frame():
+    """A frame dropped by a remote pipeline must not leak in the caller."""
+    registrar_process = Process(transport_kind="loopback")
+    Registrar(registrar_process, search_timeout=0.05)
+    registrar_process.run(in_thread=True)
+
+    remote_definition = {
+        "name": "dropper",
+        "graph": ["(sample)"],
+        "elements": [
+            {"name": "sample", "input": [{"name": "text"}],
+             "output": [{"name": "text"}],
+             "parameters": {"sample_rate": 2},
+             "deploy": local("TextSample")},
+        ],
+    }
+    process_b = Process(transport_kind="loopback")
+    create_pipeline(process_b, remote_definition)
+    process_b.run(in_thread=True)
+
+    local_definition = {
+        "name": "drop_caller",
+        "graph": ["(remote_sample)"],
+        "elements": [
+            {"name": "remote_sample", "input": [{"name": "text"}],
+             "output": [{"name": "text"}],
+             "deploy": {"remote": {"service_filter": {"name": "dropper"}}}},
+        ],
+    }
+    process_a = Process(transport_kind="loopback")
+    pipeline_a = create_pipeline(process_a, local_definition)
+    process_a.run(in_thread=True)
+    wait_for(lambda: pipeline_a.ready, timeout=10)
+
+    responses = queue.Queue()
+    stream = pipeline_a.create_stream("s1", queue_response=responses)
+    for index in range(4):
+        pipeline_a.process_frame(
+            {"stream_id": "s1"}, {"text": f"t{index}"})
+    results = drain(responses, 2)
+    texts = sorted(outputs["text"] for _, _, outputs in results)
+    assert texts == ["t0", "t2"]
+    # dropped frames released: nothing parked, pending back to zero
+    wait_for(lambda: len(stream.frames) == 0)
+    wait_for(lambda: stream.pending == 0)
+
+    for process in (registrar_process, process_b, process_a):
+        process.terminate()
+
+
+def test_stream_lease_expires_without_frames():
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, text_pipeline_definition(["x"]))
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("short", grace_time=0.1,
+                           queue_response=responses)
+    responses.get(timeout=5)  # single frame flows, then stream idles
+    wait_for(lambda: "short" not in pipeline.streams, timeout=5)
+    process.terminate()

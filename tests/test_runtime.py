@@ -1,0 +1,195 @@
+import pytest
+
+from aiko_services_tpu.runtime import (
+    Actor, ConnectionState, ECConsumer, ECProducer, Process, Registrar,
+    ServiceFilter, ServicesCache, make_proxy)
+from aiko_services_tpu.transport import reset_brokers
+from helpers import wait_for
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+def start_process(**kwargs):
+    process = Process(transport_kind="loopback", **kwargs)
+    return process
+
+
+class EchoActor(Actor):
+    def __init__(self, process, name="echo"):
+        super().__init__(process, name)
+        self.received = []
+
+    def echo(self, *args):
+        self.received.append(list(args))
+
+    def control_reset(self):
+        self.received.append("RESET")
+
+
+def test_registrar_election_and_service_registration():
+    registrar_process = start_process()
+    registrar = Registrar(registrar_process, search_timeout=0.05)
+    registrar_process.run(in_thread=True)
+    wait_for(lambda: registrar.state == "primary")
+
+    worker_process = start_process()
+    actor = EchoActor(worker_process)
+    worker_process.run(in_thread=True)
+    wait_for(lambda: worker_process.connection.is_connected(
+        ConnectionState.REGISTRAR))
+    wait_for(lambda: registrar.services_table.get_service(actor.topic_path))
+    fields = registrar.services_table.get_service(actor.topic_path)
+    assert fields.name == "echo"
+
+    registrar_process.terminate()
+    worker_process.terminate()
+
+
+def test_second_registrar_becomes_secondary():
+    process_a = start_process()
+    registrar_a = Registrar(process_a, search_timeout=0.05)
+    process_a.run(in_thread=True)
+    wait_for(lambda: registrar_a.state == "primary")
+
+    process_b = start_process()
+    registrar_b = Registrar(process_b, search_timeout=0.05)
+    process_b.run(in_thread=True)
+    wait_for(lambda: registrar_b.state == "secondary")
+
+    process_a.terminate()
+    process_b.terminate()
+
+
+def test_registrar_failover_on_lwt():
+    process_a = start_process()
+    registrar_a = Registrar(process_a, search_timeout=0.05)
+    process_a.run(in_thread=True)
+    wait_for(lambda: registrar_a.state == "primary")
+
+    process_b = start_process()
+    registrar_b = Registrar(process_b, search_timeout=0.05)
+    process_b.run(in_thread=True)
+    wait_for(lambda: registrar_b.state == "secondary")
+
+    # simulate crash: unclean disconnect fires registrar LWT
+    process_a.transport.disconnect(send_lwt=True)
+    process_a.event.terminate()
+    wait_for(lambda: registrar_b.state == "primary", timeout=5)
+    process_b.terminate()
+
+
+def test_registrar_reaps_dead_process_services():
+    registrar_process = start_process()
+    registrar = Registrar(registrar_process, search_timeout=0.05)
+    registrar_process.run(in_thread=True)
+    wait_for(lambda: registrar.state == "primary")
+
+    worker_process = start_process()
+    actor = EchoActor(worker_process)
+    worker_process.run(in_thread=True)
+    wait_for(lambda: registrar.services_table.get_service(actor.topic_path))
+
+    # crash the worker: LWT "(absent)" on its /0/state reaps all services
+    worker_process.transport.disconnect(send_lwt=True)
+    worker_process.event.terminate()
+    wait_for(lambda: registrar.services_table.get_service(
+        actor.topic_path) is None)
+    registrar_process.terminate()
+
+
+def test_remote_proxy_invocation():
+    registrar_process = start_process()
+    Registrar(registrar_process, search_timeout=0.05)
+    registrar_process.run(in_thread=True)
+
+    worker_process = start_process()
+    actor = EchoActor(worker_process)
+    worker_process.run(in_thread=True)
+
+    caller_process = start_process()
+    caller_process.run(in_thread=True)
+    proxy = make_proxy(caller_process, actor.topic_path)
+    proxy.echo("hello", "42")
+    wait_for(lambda: actor.received)
+    assert actor.received == [["hello", "42"]]
+
+    proxy.control_reset()
+    wait_for(lambda: "RESET" in actor.received)
+
+    for process in (registrar_process, worker_process, caller_process):
+        process.terminate()
+
+
+def test_ec_producer_consumer_sync():
+    producer_process = start_process()
+    actor = EchoActor(producer_process)
+    producer = ECProducer(actor)
+    actor.share["metric"] = "1"
+    producer_process.run(in_thread=True)
+
+    consumer_process = start_process()
+    consumer_process.run(in_thread=True)
+    cache = {}
+    consumer = ECConsumer(consumer_process, cache, actor.topic_path,
+                          lease_time=60)
+    wait_for(lambda: consumer.synced)
+    assert cache["metric"] == "1"
+    assert cache["lifecycle"] == "ready"
+
+    producer.update("metric", "2")
+    wait_for(lambda: cache.get("metric") == "2")
+
+    producer.update("nested.value", "7")
+    wait_for(lambda: cache.get("nested", {}).get("value") == "7")
+
+    producer.remove("metric")
+    wait_for(lambda: "metric" not in cache)
+
+    consumer.terminate()
+    producer_process.terminate()
+    consumer_process.terminate()
+
+
+def test_ec_remote_write_via_control_topic():
+    producer_process = start_process()
+    actor = EchoActor(producer_process)
+    ECProducer(actor)
+    producer_process.run(in_thread=True)
+
+    writer_process = start_process()
+    writer_process.run(in_thread=True)
+    writer_process.publish(actor.topic_control, "(update log_level DEBUG)")
+    wait_for(lambda: actor.share.get("log_level") == "DEBUG")
+    producer_process.terminate()
+    writer_process.terminate()
+
+
+def test_services_cache_mirrors_registrar():
+    registrar_process = start_process()
+    registrar = Registrar(registrar_process, search_timeout=0.05)
+    registrar_process.run(in_thread=True)
+    wait_for(lambda: registrar.state == "primary")
+
+    worker_process = start_process()
+    actor = EchoActor(worker_process)
+    worker_process.run(in_thread=True)
+
+    observer_process = start_process()
+    cache = ServicesCache(observer_process)
+    events = []
+    cache.add_handler(lambda command, fields: events.append(
+        (command, fields.name)), ServiceFilter(name="echo"))
+    observer_process.run(in_thread=True)
+
+    wait_for(lambda: ("add", "echo") in events)
+
+    actor.stop()
+    wait_for(lambda: ("remove", "echo") in events)
+
+    for process in (registrar_process, worker_process, observer_process):
+        process.terminate()

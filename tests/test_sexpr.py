@@ -1,0 +1,119 @@
+import pytest
+
+from aiko_services_tpu.utils import (
+    generate, parse, parse_list_to_dict, parse_int, parse_float, parse_number,
+    ParseError)
+
+
+def test_simple_command():
+    assert parse("(add a b)") == ("add", ["a", "b"])
+
+
+def test_bare_atom():
+    assert parse("topic") == ("topic", [])
+
+
+def test_empty_payload():
+    assert parse("") == ("", [])
+    assert parse("()") == ("", [])
+
+
+def test_nested_lists():
+    command, parameters = parse("(process (a b) (c (d e)))")
+    assert command == "process"
+    assert parameters == [["a", "b"], ["c", ["d", "e"]]]
+
+
+def test_keyword_dict():
+    command, parameters = parse("(update (a: 1 b: 2))")
+    assert command == "update"
+    assert parameters == [{"a": "1", "b": "2"}]
+
+
+def test_keyword_dict_nested_value():
+    command, parameters = parse("(f (x: (1 2) y: ok))")
+    assert parameters == [{"x": ["1", "2"], "y": "ok"}]
+
+
+def test_quoted_strings():
+    command, parameters = parse('(say "hello world" "a (b)")')
+    assert parameters == ["hello world", "a (b)"]
+
+
+def test_quoted_escape():
+    command, parameters = parse(r'(say "a \"b\" \\c")')
+    assert parameters == ['a "b" \\c']
+
+
+def test_canonical_symbol():
+    command, parameters = parse("(data 11:hello world x)")
+    assert parameters == ["hello world", "x"]
+
+
+def test_canonical_symbol_binary_safe():
+    payload = generate("blob", [b"\x00\x01() \xff"])
+    command, parameters = parse(payload)
+    assert command == "blob"
+    assert parameters[0] == "\x00\x01() \xff"
+
+
+def test_generate_parse_roundtrip():
+    cases = [
+        ("add", ["a", "1", "2.5"]),
+        ("share", [{"topic": "ns/h/1/1", "lease": "300"}]),
+        ("graph", [["PE_0", ["PE_1", "PE_3"], ["PE_2", "PE_3"]]]),
+        ("msg", ["with space", 'quote"inside'],),
+        ("nested", [{"a": ["1", "2"], "b": {"c": "d"}}]),
+    ]
+    for command, parameters in cases:
+        payload = generate(command, parameters)
+        out_command, out_parameters = parse(payload)
+        assert out_command == command
+        # ints/floats stringify on the wire
+        assert out_parameters == [
+            _stringify(parameter) for parameter in parameters]
+
+
+def _stringify(value):
+    if isinstance(value, dict):
+        return {key: _stringify(item) for key, item in value.items()}
+    if isinstance(value, list):
+        return [_stringify(item) for item in value]
+    return str(value)
+
+
+def test_digit_colon_atom_roundtrips():
+    # "12:34" must NOT be emitted as a bare atom (it would re-parse as a
+    # canonical len:data symbol)
+    payload = generate("update", ["time", "12:34"])
+    command, parameters = parse(payload)
+    assert command == "update"
+    assert parameters == ["time", "12:34"]
+
+
+def test_generate_types():
+    assert generate("f", [1, 2.5, True, None]) == "(f 1 2.5 true ())"
+
+
+def test_unterminated_list_raises():
+    with pytest.raises(ParseError):
+        parse("(a (b c)")
+
+
+def test_trailing_data_raises():
+    with pytest.raises(ParseError):
+        parse("(a) (b)")
+
+
+def test_parse_list_to_dict():
+    assert parse_list_to_dict(["a:", "1", "b:", "2"]) == {"a": "1", "b": "2"}
+    assert parse_list_to_dict(["a", "1"]) == {"a": "1"}
+
+
+def test_number_helpers():
+    assert parse_int("42") == 42
+    assert parse_int("x", 7) == 7
+    assert parse_float("2.5") == 2.5
+    assert parse_number("3") == 3
+    assert parse_number("3.5") == 3.5
+    assert parse_number("zzz", -1) == -1

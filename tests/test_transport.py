@@ -1,0 +1,113 @@
+import pytest
+
+from aiko_services_tpu.transport import (
+    LoopbackTransport, get_broker, reset_brokers, topic_matches)
+from helpers import wait_for
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+def test_topic_matches():
+    assert topic_matches("a/b/c", "a/b/c")
+    assert topic_matches("a/+/c", "a/b/c")
+    assert not topic_matches("a/+/c", "a/b/d")
+    assert topic_matches("a/#", "a/b/c/d")
+    assert topic_matches("#", "anything/at/all")
+    assert not topic_matches("a/b", "a/b/c")
+    assert not topic_matches("a/b/c", "a/b")
+    assert topic_matches("+/+/+/+/state", "ns/host/1/0/state")
+
+
+def test_publish_subscribe():
+    received = []
+    alpha = LoopbackTransport(lambda t, p: received.append((t, p)))
+    beta = LoopbackTransport()
+    alpha.subscribe("ns/test/in")
+    alpha.connect()
+    beta.connect()
+    beta.publish("ns/test/in", "(hello world)")
+    beta.publish("ns/other", "(ignored)")
+    wait_for(lambda: received)
+    assert received == [("ns/test/in", "(hello world)")]
+
+
+def test_wildcard_subscription():
+    received = []
+    alpha = LoopbackTransport(lambda t, p: received.append(t))
+    alpha.subscribe("ns/+/state")
+    alpha.connect()
+    beta = LoopbackTransport()
+    beta.connect()
+    beta.publish("ns/a/state", "x")
+    beta.publish("ns/b/state", "y")
+    beta.publish("ns/a/other", "z")
+    get_broker().drain()
+    assert sorted(received) == ["ns/a/state", "ns/b/state"]
+
+
+def test_retained_message_delivered_on_subscribe():
+    beta = LoopbackTransport()
+    beta.connect()
+    beta.publish("ns/boot", "(primary found x)", retain=True)
+    get_broker().drain()
+    received = []
+    alpha = LoopbackTransport(lambda t, p: received.append((t, p)))
+    alpha.connect()
+    alpha.subscribe("ns/boot")
+    wait_for(lambda: received)
+    assert received == [("ns/boot", "(primary found x)")]
+
+
+def test_retained_cleared_by_empty_payload():
+    beta = LoopbackTransport()
+    beta.connect()
+    beta.publish("ns/boot", "(x)", retain=True)
+    beta.publish("ns/boot", "", retain=True)
+    get_broker().drain()
+    assert get_broker().retained("ns/boot") is None
+
+
+def test_lwt_fires_on_unclean_disconnect():
+    received = []
+    watcher = LoopbackTransport(lambda t, p: received.append((t, p)))
+    watcher.subscribe("ns/victim/state")
+    watcher.connect()
+    victim = LoopbackTransport()
+    victim.set_last_will_and_testament("ns/victim/state", "(absent)",
+                                       retain=True)
+    victim.connect()
+    victim.disconnect(send_lwt=True)
+    wait_for(lambda: received)
+    assert received == [("ns/victim/state", "(absent)")]
+    assert get_broker().retained("ns/victim/state") == "(absent)"
+
+
+def test_no_lwt_on_clean_disconnect():
+    received = []
+    watcher = LoopbackTransport(lambda t, p: received.append((t, p)))
+    watcher.subscribe("ns/victim/state")
+    watcher.connect()
+    victim = LoopbackTransport()
+    victim.set_last_will_and_testament("ns/victim/state", "(absent)")
+    victim.connect()
+    victim.disconnect(send_lwt=False)
+    get_broker().drain()
+    assert received == []
+
+
+def test_disconnected_client_receives_nothing():
+    received = []
+    alpha = LoopbackTransport(lambda t, p: received.append(t))
+    alpha.subscribe("ns/x")
+    alpha.connect()
+    alpha.disconnect()
+    beta = LoopbackTransport()
+    beta.connect()
+    beta.publish("ns/x", "1")
+    get_broker().drain()
+    assert received == []
