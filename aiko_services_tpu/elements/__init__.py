@@ -6,3 +6,5 @@ from .text_io import (                                        # noqa: F401
 from .toys import (                                           # noqa: F401
     PE_Number, PE_Add, PE_Multiply, PE_Sum2, PE_Inspect, PE_Metrics,
     PE_RandomIntegers)
+from .compute import (                                        # noqa: F401
+    ArraySource, JaxScale, JaxMLP, ToHost)

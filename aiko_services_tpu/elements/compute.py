@@ -1,0 +1,71 @@
+# JAX compute toy elements: the smallest real ComputeElements, used by
+# tests and as templates for user elements.  No reference counterpart --
+# the reference's compute lives in torch/CUDA user code (reference:
+# src/aiko_services/examples/yolo/yolo.py:51-87); here it is jit-compiled
+# JAX running on whatever mesh the definition names.
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..pipeline import ComputeElement, StreamEvent
+from .common_io import DataSource
+
+__all__ = ["ArraySource", "JaxScale", "JaxMLP", "ToHost"]
+
+
+class ArraySource(DataSource):
+    """Emits {"tensor": ndarray} frames; data_sources items give shapes,
+    e.g. [[8, 16], [8, 16]] emits two 8x16 arrays (seeded, deterministic)."""
+
+    def read_item(self, stream, item) -> dict:
+        shape = tuple(int(size) for size in item)
+        rng = np.random.default_rng(
+            int(self.get_parameter("seed", 0, stream)) + stream.frame_id)
+        return {"tensor": rng.standard_normal(shape, dtype=np.float32)}
+
+
+class JaxScale(ComputeElement):
+    """tensor -> tensor * scale + offset: stateless pure-JAX element.
+    scale/offset are dynamic parameters, so live updates (dashboard, EC
+    share, stream overrides) apply without recompiling."""
+
+    def dynamic_parameters(self, stream):
+        return {"scale": float(self.get_parameter("scale", 2.0, stream)),
+                "offset": float(self.get_parameter("offset", 0.0, stream))}
+
+    def compute(self, state, tensor, scale, offset):
+        return {"tensor": tensor * scale + offset}
+
+
+class JaxMLP(ComputeElement):
+    """Two-layer MLP over the last axis: a stateful ComputeElement whose
+    params live on the element's mesh (definition "sharding" block)."""
+
+    def setup(self):
+        features = int(self.get_parameter("features", 16))
+        hidden = int(self.get_parameter("hidden", 32))
+        key = jax.random.PRNGKey(int(self.get_parameter("seed", 0)))
+        k1, k2 = jax.random.split(key)
+        return {
+            "w1": jax.random.normal(k1, (features, hidden),
+                                    jnp.float32) / np.sqrt(features),
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "w2": jax.random.normal(k2, (hidden, features),
+                                    jnp.float32) / np.sqrt(hidden),
+            "b2": jnp.zeros((features,), jnp.float32),
+        }
+
+    def compute(self, state, tensor):
+        hidden = jax.nn.gelu(tensor @ state["w1"] + state["b1"])
+        return {"tensor": hidden @ state["w2"] + state["b2"]}
+
+
+class ToHost(ComputeElement):
+    """Device -> host boundary: returns the tensor as numpy (the explicit
+    Sink-side transfer point; everything upstream stays on device)."""
+
+    def process_frame(self, stream, tensor):
+        return StreamEvent.OKAY, {"tensor": np.asarray(tensor)}

@@ -1,0 +1,310 @@
+# Attention kernels and sequence parallelism.
+#
+# The reference has NO sequence parallelism -- long audio is handled by
+# temporal chunking (reference: src/aiko_services/examples/speech/
+# speech_elements.py:54-83) and LLM context is a single prompt.  This module
+# supplies the real thing for TPU (SURVEY.md 2.4, 5):
+#
+#   flash_attention  -- blockwise online-softmax attention as a Pallas TPU
+#                       kernel (MXU matmuls, VMEM-resident blocks, f32
+#                       accumulation); interpreter mode on CPU for tests.
+#   ring_attention   -- sequence-parallel attention: Q stays put, KV blocks
+#                       rotate around the mesh "seq" axis via ppermute; each
+#                       hop overlaps with blockwise attention compute and
+#                       merges via the associative online-softmax update.
+#   ulysses_attention - all-to-all alternative: swap seq-sharding for
+#                       head-sharding, run dense local attention, swap back.
+#
+# All take q/k/v shaped (batch, heads, seq, head_dim).
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from ..utils.padding import pad_axis_to
+from .mesh import create_mesh  # noqa: F401  (re-exported convenience)
+
+__all__ = [
+    "attention_reference", "flash_attention", "ring_attention",
+    "ulysses_attention",
+]
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def attention_reference(q, k, v, causal: bool = False, sm_scale=None):
+    """Plain-XLA softmax attention: the correctness oracle for the kernels."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k,
+        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len)
+        k_pos = jnp.arange(k_len)[None, :]
+        logits = jnp.where(k_pos <= q_pos, logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+# -- Pallas flash attention -------------------------------------------------
+
+_STAT_LANES = 128  # min f32 lane width for the m/l scratch tiles
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  causal: bool, sm_scale: float, kv_len: int, q_offset: int):
+    """One (batch*head, q_block, k_block) grid step of the online-softmax
+    recurrence.  K/V stream through VMEM one block per step (HBM->VMEM via
+    the grid pipeline -- whole-sequence K/V never resides on chip), with
+    m/l/acc scratch persisting across the sequential k dimension."""
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    # program ids must be read OUTSIDE pl.when bodies (interpret-mode
+    # lowering of program_id inside cond is unsupported)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+    q_base = qi * block_q + q_offset
+    q_pos = (q_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0))
+    k_pos = (ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1))
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    needed = ki * block_k < kv_len
+    if causal:  # skip blocks entirely above the causal diagonal
+        needed = jnp.logical_and(
+            needed, ki * block_k <= q_base + block_q - 1)
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale    # (block_q, d)
+        k_blk = k_ref[0].astype(jnp.float32)           # (block_k, d)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (block_q, block_k)
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(ki == num_kb - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(
+            l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_seq(x, block: int):
+    length = x.shape[2]
+    padded = ((length + block - 1) // block) * block
+    return pad_axis_to(x, 2, padded)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "block_q", "block_k", "q_offset"))
+def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
+                    block_q: int = 128, block_k: int = 128,
+                    q_offset: int = 0):
+    """Blockwise attention, (B, H, L, D) in and out.
+
+    q_offset shifts the causal mask for callers whose q shard starts at a
+    nonzero global position (ring attention resumes, KV-cached decode).
+    """
+    batch, heads, q_len, head_dim = q.shape
+    kv_len = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    block_q = min(block_q, max(q_len, 1))
+    block_k = min(block_k, max(kv_len, 1))
+
+    q_padded = _pad_seq(q, block_q).reshape(
+        batch * heads, -1, head_dim)
+    k_padded = _pad_seq(k, block_k).reshape(
+        batch * heads, -1, head_dim)
+    v_padded = _pad_seq(v, block_k).reshape(
+        batch * heads, -1, head_dim)
+    padded_q_len = q_padded.shape[1]
+    # k blocks stream through the grid's sequential minor dimension, so
+    # VMEM holds one (block_q, d) q tile + one (block_k, d) k/v tile each
+    # step regardless of sequence length
+    grid = (batch * heads, padded_q_len // block_q,
+            k_padded.shape[1] // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal, sm_scale=float(sm_scale), kv_len=kv_len,
+        q_offset=int(q_offset) + (kv_len - q_len if causal else 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim),
+                         lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim),
+                         lambda bh, qi, ki: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim),
+                         lambda bh, qi, ki: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch * heads, padded_q_len, head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),   # m
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),   # l
+            pltpu.VMEM((block_q, head_dim), jnp.float32),      # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q_padded, k_padded, v_padded)
+    return out.reshape(batch, heads, padded_q_len, head_dim)[:, :, :q_len]
+
+
+# -- Ring attention (sequence parallel) -------------------------------------
+
+def _block_attention_stats(q, k, v, sm_scale, mask):
+    """One blockwise partial-attention step returning (m, l, acc) online-
+    softmax statistics so partial results merge associatively."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return m, l, acc
+
+
+def ring_attention_sharded(q, k, v, axis_name: str = "seq",
+                           causal: bool = True, sm_scale=None):
+    """Sequence-parallel attention over mesh axis `axis_name`; call INSIDE
+    shard_map with q/k/v seq-sharded as (B, H, L/n, D).
+
+    Q stays resident; K/V shards rotate n-1 hops around the ring via
+    ppermute (XLA lowers to ICI collective-permute, overlapping each hop
+    with the current block's MXU work).  Per-hop partials merge with the
+    associative online-softmax update, so the result is exact.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    batch, heads, local_len, head_dim = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+
+    q_f32 = q.astype(jnp.float32)
+    q_pos = (my_index * local_len
+             + jnp.arange(local_len)[None, None, :, None])
+
+    m = jnp.full((batch, heads, local_len, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((batch, heads, local_len, 1), jnp.float32)
+    acc = jnp.zeros((batch, heads, local_len, head_dim), jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    k_blk, v_blk = k, v
+    for step in range(axis_size):
+        src_index = (my_index - step) % axis_size
+        k_pos = (src_index * local_len
+                 + jnp.arange(local_len)[None, None, None, :])
+        mask = (k_pos <= q_pos) if causal else jnp.ones(
+            (batch, heads, local_len, local_len), bool)
+        m_blk, l_blk, acc_blk = _block_attention_stats(
+            q_f32, k_blk.astype(jnp.float32), v_blk, sm_scale, mask)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l = l * alpha + l_blk * beta
+        acc = acc * alpha + acc_blk * beta
+        m = m_new
+        if step + 1 < axis_size:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "seq",
+                   causal: bool = True, sm_scale=None):
+    """shard_map entry point: shards (B, H, L, D) on the seq axis and runs
+    ring_attention_sharded over the mesh."""
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+
+
+# -- Ulysses (all-to-all) sequence parallelism ------------------------------
+
+def ulysses_attention_sharded(q, k, v, axis_name: str = "seq",
+                              causal: bool = False, sm_scale=None):
+    """DeepSpeed-Ulysses style: all-to-all swaps seq-sharding for
+    head-sharding, dense local attention (flash kernel) over the full
+    sequence, then all-to-all back.  Call INSIDE shard_map with q/k/v
+    seq-sharded (B, H, L/n, D); the head count must be divisible by the
+    axis size."""
+    axis_size = jax.lax.axis_size(axis_name)
+    heads = q.shape[1]
+    if heads % axis_size != 0:
+        raise ValueError(
+            f"ulysses_attention: heads ({heads}) must be divisible by "
+            f"mesh axis '{axis_name}' size ({axis_size}); use "
+            f"ring_attention for head counts smaller than the axis")
+    def seq_to_heads(x):   # (B, H, L/n, D) -> (B, H/n, L, D)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def heads_to_seq(x):   # (B, H/n, L, D) -> (B, H, L/n, D)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    out = flash_attention(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+        causal=causal, sm_scale=sm_scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "seq",
+                      causal: bool = False, sm_scale=None):
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ulysses_attention_sharded, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    # check_vma=False: pallas_call inside shard_map can't declare varying
+    # mesh axes on its ShapeDtypeStruct outputs yet
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)(q, k, v)

@@ -1,0 +1,217 @@
+# ComputeElement: the TPU compute contract for pipeline elements.
+#
+# This layer has no reference counterpart -- the reference's elements call
+# torch/CUDA libraries ad hoc inside process_frame (reference:
+# src/aiko_services/examples/yolo/yolo.py:51-87,
+# examples/speech/speech_elements.py:229-262).  Here element math is a PURE
+# JAX function compiled once per shape bucket:
+#
+#   class MyElement(ComputeElement):
+#       def setup(self) -> state:            # build params (pytree) once
+#       def compute(self, state, **inputs):  # pure jax fn -> outputs dict
+#       def dynamic_parameters(self, stream) -> dict   # optional: traced
+#           # per-frame values (live-updatable without recompiling)
+#
+# The engine: places state on the element's mesh (definition "sharding"
+# block) with NamedSharding; jits compute; pads variable axes to
+# power-of-two buckets so jit's shape-keyed cache stays small and un-pads
+# matching output axes afterwards; keeps outputs on device (jax.Array in
+# the swag) so a downstream ComputeElement never touches the host.
+#
+# Parameter semantics: plain get_parameter() reads inside compute() are
+# baked in at trace time (cheap, but live updates need a recompile); values
+# returned from dynamic_parameters() are fed as traced 0-d arrays each
+# frame, so dashboard/EC updates apply immediately at zero recompile cost.
+
+from __future__ import annotations
+
+import inspect
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import get_mesh, named_sharding, shard_pytree
+from ..utils import get_logger
+from ..utils.padding import bucket_length, pad_axis_to  # noqa: F401
+from .element import PipelineElement
+from .stream import Stream, StreamEvent
+
+__all__ = ["ComputeElement", "bucket_length", "pad_axis_to"]
+
+_LOGGER = get_logger("tpu_element")
+
+
+class ComputeElement(PipelineElement):
+    """PipelineElement whose math is a pure, jit-compiled JAX function.
+
+    Definition parameters understood by the engine:
+      sharding:        {"axes": {"data": -1, ...},
+                        "state": <spec or pytree of specs>,
+                        "inputs": {input_name: spec}}
+      bucket_axes:     {input_name: axis_index} -- pad that axis to a bucket
+      bucket_min:      minimum bucket size (default 16)
+      buckets:         explicit bucket ladder, e.g. [128, 512, 2048]
+      unpad_outputs:   slice bucket padding off outputs whose bucketed axis
+                       matches the padded input size (default True)
+      blocking_metrics: bool -- block_until_ready inside the timing window
+
+    If compute() declares a `lengths` keyword, the engine passes a dict
+    {input_name: int32 scalar} of pre-padding lengths so kernels can mask
+    padded positions.
+    """
+
+    def __init__(self, process, pipeline, definition):
+        super().__init__(process, pipeline, definition)
+        sharding = dict(definition.sharding or {})
+        self.mesh = get_mesh(sharding.get("axes")) if sharding else None
+        self._state_spec = sharding.get("state")
+        self._input_specs = dict(sharding.get("inputs", {}))
+        self._bucket_axes = dict(
+            self.get_parameter("bucket_axes", {}) or {})
+        self._bucket_min = int(self.get_parameter("bucket_min", 16))
+        self._buckets = self.get_parameter("buckets", None)
+        self._unpad_outputs = bool(
+            self.get_parameter("unpad_outputs", True))
+        self._blocking_metrics = bool(
+            self.get_parameter("blocking_metrics", False))
+        self.state = None
+        self._compiled = None
+        self._accepts_lengths = False
+        self._replicated_warned: set = set()
+
+    # -- the compute contract (override these) -----------------------------
+
+    def setup(self):
+        """Build the element's device state (params pytree); called lazily
+        before the first frame.  Return None for stateless elements."""
+        return None
+
+    def compute(self, state, **inputs) -> dict:
+        """PURE function: jax in, jax out.  No side effects, no Python
+        branching on traced values."""
+        raise NotImplementedError
+
+    def dynamic_parameters(self, stream: Stream) -> dict:
+        """Per-frame parameter values to pass as TRACED kwargs to compute.
+        Read get_parameter(...) here (not inside compute) for live-updatable
+        values: they enter the compiled fn as 0-d arrays, so updates apply
+        without recompilation."""
+        return {}
+
+    # -- engine ------------------------------------------------------------
+
+    def _ensure_ready(self):
+        if self._compiled is not None:
+            return
+        state = self.setup()
+        if state is not None and self.mesh is not None:
+            state = shard_pytree(state, self.mesh, self._state_spec)
+        self.state = state
+        signature = inspect.signature(self.compute)
+        self._accepts_lengths = "lengths" in signature.parameters
+
+        def _call(state, dynamic, kwargs):
+            outputs = self.compute(state, **dynamic, **kwargs)
+            if not isinstance(outputs, dict):
+                raise TypeError(
+                    f"{self.definition.name}.compute must return a dict")
+            return outputs
+
+        self._compiled = jax.jit(_call)
+
+    def _place_inputs(self, inputs: dict) -> tuple[dict, dict]:
+        """Returns (placed inputs, padding info {name: (axis, original)})."""
+        placed, padding = {}, {}
+        for name, value in inputs.items():
+            if isinstance(value, (np.ndarray, jnp.ndarray)) or hasattr(
+                    value, "__jax_array__"):
+                axis = self._bucket_axes.get(name)
+                if axis is not None:
+                    original = value.shape[int(axis)]
+                    target = bucket_length(
+                        original, self._bucket_min, self._buckets)
+                    if target != original:
+                        value = pad_axis_to(value, int(axis), target)
+                        padding[name] = (int(axis), original)
+                spec = self._input_specs.get(name)
+                if self.mesh is not None and spec is not None:
+                    sharding = named_sharding(self.mesh, spec)
+                    try:
+                        sharding.shard_shape(tuple(value.shape))
+                    except ValueError:
+                        # dim not divisible by its mesh axis: replicate
+                        # rather than fail the frame -- but say so, this
+                        # forfeits the parallelism the definition asked for
+                        if name not in self._replicated_warned:
+                            self._replicated_warned.add(name)
+                            _LOGGER.warning(
+                                "%s: input '%s' shape %s not divisible by "
+                                "mesh axes %s; running REPLICATED",
+                                self.definition.name, name,
+                                tuple(value.shape), sharding.spec)
+                        value = jnp.asarray(value)
+                    else:
+                        value = jax.device_put(value, sharding)
+                elif isinstance(value, np.ndarray):
+                    value = jnp.asarray(value)
+            placed[name] = value
+        return placed, padding
+
+    def _unpad(self, outputs: dict, inputs: dict, padding: dict) -> dict:
+        """Slice bucket padding back off: any output array whose bucketed
+        axis has exactly the padded input's size is restored to the
+        original length (opt out with unpad_outputs=false)."""
+        if not padding or not self._unpad_outputs:
+            return outputs
+        result = {}
+        for name, value in outputs.items():
+            # every padded axis is restored (an output may carry several
+            # bucketed axes, e.g. an outer product of two padded inputs)
+            sliced_axes: set = set()
+            for input_name, (axis, original) in padding.items():
+                padded_size = inputs[input_name].shape[axis]
+                if (hasattr(value, "shape") and value.ndim > axis
+                        and axis not in sliced_axes
+                        and value.shape[axis] == padded_size):
+                    index = [slice(None)] * value.ndim
+                    index[axis] = slice(0, original)
+                    value = value[tuple(index)]
+                    sliced_axes.add(axis)
+            result[name] = value
+        return result
+
+    def process_frame(self, stream: Stream, **inputs) -> tuple:
+        self._ensure_ready()
+        host_start = time.perf_counter()
+        placed, padding = self._place_inputs(inputs)
+        dynamic = {
+            key: jnp.asarray(value)
+            for key, value in self.dynamic_parameters(stream).items()}
+        if self._accepts_lengths:
+            dynamic["lengths"] = {
+                name: jnp.int32(inputs[name].shape[int(axis)])
+                for name, axis in self._bucket_axes.items()
+                if name in inputs}
+        try:
+            outputs = self._compiled(self.state, dynamic, placed)
+        except TypeError as error:
+            bad = {name: type(value).__name__
+                   for name, value in placed.items()
+                   if not hasattr(value, "shape")
+                   and not isinstance(value, (bool, int, float, complex,
+                                              list, tuple))}
+            if bad:
+                raise TypeError(
+                    f"{self.definition.name}: inputs {bad} are not JAX "
+                    f"types; ComputeElement inputs must be arrays or "
+                    f"numbers (route strings/objects around compute "
+                    f"elements with map_in/map_out)") from error
+            raise
+        outputs = self._unpad(outputs, placed, padding)
+        if self._blocking_metrics:
+            outputs = jax.block_until_ready(outputs)
+        stream.variables.setdefault("compute_seconds", {})[
+            self.definition.name] = time.perf_counter() - host_start
+        return StreamEvent.OKAY, outputs

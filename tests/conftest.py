@@ -1,11 +1,22 @@
-# Test configuration: force JAX onto a virtual 8-device CPU mesh BEFORE jax
-# is imported anywhere, so sharding/collective tests run without TPU hardware.
+# Test configuration: force JAX onto a virtual 8-device CPU mesh so
+# sharding/collective tests run without TPU hardware.
+#
+# The environment's sitecustomize imports jax at interpreter start (before
+# conftest), so setting JAX_PLATFORMS via os.environ is too late -- we must
+# update jax.config directly.  XLA_FLAGS still works because the CPU backend
+# client is created lazily on first device access.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("AIKO_NAMESPACE", "aiko_test")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    "tests need the virtual 8-device CPU mesh; got "
+    f"{jax.devices()}")

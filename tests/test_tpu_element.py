@@ -1,0 +1,200 @@
+# ComputeElement tests: jit-compiled element math in a live pipeline, with
+# device-resident swag between elements, shape bucketing, and mesh-sharded
+# state -- all on the virtual 8-device CPU mesh.
+
+import queue
+
+import jax
+import numpy as np
+import pytest
+
+from aiko_services_tpu.pipeline import (
+    bucket_length, create_pipeline, pad_axis_to)
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.transport import reset_brokers
+
+ELEMENTS = "aiko_services_tpu.elements"
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+def local(class_name):
+    return {"local": {"module": ELEMENTS, "class_name": class_name}}
+
+
+def test_bucket_length():
+    assert bucket_length(1) == 16
+    assert bucket_length(17) == 32
+    assert bucket_length(100, buckets=[128, 512]) == 128
+    # beyond the last bucket: grow power-of-two, never truncate
+    assert bucket_length(1000, buckets=[128, 512]) == 1024
+
+
+def test_pad_axis_to():
+    array = np.ones((2, 5), np.float32)
+    padded = pad_axis_to(array, 1, 8)
+    assert padded.shape == (2, 8)
+    assert padded[0, 5] == 0
+    with pytest.raises(ValueError, match="shrink"):
+        pad_axis_to(array, 1, 4)
+
+
+def test_bucketing_pads_compute_and_unpads_outputs():
+    definition = {
+        "name": "bucketed",
+        "graph": ["(source (scale (sink)))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "tensor"}],
+             "parameters": {"data_sources": [[4, 50]]},  # ragged axis 1
+             "deploy": local("ArraySource")},
+            {"name": "scale", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "parameters": {"scale": 2.0, "bucket_axes": {"tensor": 1},
+                            "bucket_min": 16},
+             "deploy": local("JaxScale")},
+            {"name": "sink", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "deploy": local("ToHost")},
+        ],
+    }
+    _, _, outputs = _run_one_frame(definition)
+    # padded to 64 inside compute, sliced back to 50 on the way out
+    assert outputs["tensor"].shape == (4, 50)
+
+
+def test_dynamic_parameters_apply_without_recompile():
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, {
+        "name": "dynamic",
+        "graph": ["(source (scale (sink)))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "tensor"}],
+             "parameters": {"data_sources": [[2, 4]], "seed": 3},
+             "deploy": local("ArraySource")},
+            {"name": "scale", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "parameters": {"scale": 1.0, "offset": 0.0},
+             "deploy": local("JaxScale")},
+            {"name": "sink", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "deploy": local("ToHost")},
+        ],
+    })
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    # frame 1 with scale=1, then live-update to scale=100 for frame 2
+    pipeline.create_stream("s1", queue_response=responses)
+    _, _, first = responses.get(timeout=15)
+    pipeline.elements["scale"].set_parameter("scale", 100.0)
+    pipeline.process_frame({"stream_id": "s1"},
+                           {"tensor": np.ones((2, 4), np.float32)})
+    _, _, second = responses.get(timeout=15)
+    np.testing.assert_allclose(second["tensor"],
+                               np.full((2, 4), 100.0), rtol=1e-6)
+    process.terminate()
+
+
+def _compute_pipeline(sharding=None):
+    mlp = {"name": "mlp", "input": [{"name": "tensor"}],
+           "output": [{"name": "tensor"}],
+           "parameters": {"features": 16, "hidden": 32},
+           "deploy": local("JaxMLP")}
+    if sharding:
+        mlp["sharding"] = sharding
+    return {
+        "name": "compute_pipeline",
+        "graph": ["(source (scale (mlp (sink))))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "tensor"}],
+             "parameters": {"data_sources": [[4, 16]]},
+             "deploy": local("ArraySource")},
+            {"name": "scale", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "parameters": {"scale": 3.0},
+             "deploy": local("JaxScale")},
+            mlp,
+            {"name": "sink", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "deploy": local("ToHost")},
+        ],
+    }
+
+
+def _run_one_frame(definition):
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    _, frame, outputs = responses.get(timeout=15)
+    process.terminate()
+    return pipeline, frame, outputs
+
+
+def test_compute_pipeline_end_to_end():
+    pipeline, frame, outputs = _run_one_frame(_compute_pipeline())
+    assert isinstance(outputs["tensor"], np.ndarray)
+    assert outputs["tensor"].shape == (4, 16)
+    assert "time_mlp" in frame.metrics
+
+
+def test_intermediate_swag_stays_on_device():
+    """Between ComputeElements the tensor must be a jax.Array, never numpy:
+    verified by a probe element inserted mid-graph."""
+    definition = _compute_pipeline()
+    definition["graph"] = ["(source (scale (probe (mlp (sink)))))"]
+    definition["elements"].insert(2, {
+        "name": "probe", "input": [{"name": "tensor"}],
+        "output": [{"name": "tensor"}],
+        "deploy": local("PE_Inspect")})
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    responses.get(timeout=15)
+    inspected = stream.variables["inspected"]
+    assert isinstance(inspected[0]["tensor"], jax.Array)
+    process.terminate()
+
+
+def test_sharded_state_on_mesh():
+    sharding = {"axes": {"data": -1},
+                "state": None,                      # params replicated
+                "inputs": {"tensor": ["data", None]}}  # batch sharded
+    definition = _compute_pipeline(sharding)
+    # batch 8: divisible across the 8-device data axis
+    definition["elements"][0]["parameters"]["data_sources"] = [[8, 16]]
+    pipeline, _, outputs = _run_one_frame(definition)
+    element = pipeline.elements["mlp"]
+    assert element.mesh is not None
+    assert element.mesh.devices.size == 8
+    assert element.state["w1"].sharding.is_fully_replicated
+    assert outputs["tensor"].shape == (8, 16)
+
+
+def test_scale_element_math():
+    _, _, outputs = _run_one_frame({
+        "name": "just_scale",
+        "graph": ["(source (scale (sink)))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "tensor"}],
+             "parameters": {"data_sources": [[2, 4]], "seed": 7},
+             "deploy": local("ArraySource")},
+            {"name": "scale", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "parameters": {"scale": 10.0, "offset": 1.0},
+             "deploy": local("JaxScale")},
+            {"name": "sink", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "deploy": local("ToHost")},
+        ],
+    })
+    rng = np.random.default_rng(7)
+    expected = rng.standard_normal((2, 4), dtype=np.float32) * 10.0 + 1.0
+    np.testing.assert_allclose(outputs["tensor"], expected, rtol=1e-5)
