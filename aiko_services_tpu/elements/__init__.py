@@ -7,4 +7,13 @@ from .toys import (                                           # noqa: F401
     PE_Number, PE_Add, PE_Multiply, PE_Sum2, PE_Inspect, PE_Metrics,
     PE_RandomIntegers)
 from .compute import (                                        # noqa: F401
-    ArraySource, JaxScale, JaxMLP, ToHost)
+    ArraySource, TokenSource, MultiModalSource, JaxScale, JaxMLP, ToHost)
+from .ml import (                                             # noqa: F401
+    LMForward, LMGenerate, SpeechToText, Detector, TokensToText)
+from .image_io import (                                       # noqa: F401
+    ImageReadFile, ImageSource, ImageResize, ImageOverlay, ImageWriteFile,
+    ImageOutput)
+from .audio_io import (                                       # noqa: F401
+    AudioReadFile, AudioWriteFile, ToneSource, AudioFraming, AudioSample)
+from .video_io import (                                       # noqa: F401
+    VideoReadFile, VideoSample, VideoWriteFile, VideoOutput)

@@ -1,0 +1,92 @@
+# Audio I/O elements.
+#
+# Capability parity with the reference audio stack (reference:
+# src/aiko_services/elements/media/audio_io.py -- AudioReadFile skeleton
+# plus the disabled-in-docstring microphone/speaker/FFT/resampler suite
+# :162-643, and PE_AudioFraming's LRU sliding window,
+# examples/speech/speech_elements.py:54-83).  Microphone/speaker hardware
+# elements are stubbed (no audio devices in a TPU pod); the framing,
+# file-read, and synthesis elements are full implementations.
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..pipeline import PipelineElement, StreamEvent
+from ..utils import get_logger
+from .common_io import DataSource, DataTarget, Sample
+
+__all__ = ["AudioReadFile", "AudioWriteFile", "ToneSource", "AudioFraming",
+           "AudioSample", "synthesize_tone", "SAMPLE_RATE"]
+
+_LOGGER = get_logger("audio_io")
+SAMPLE_RATE = 16000  # reference audio_io.py:455-460: 16 kHz
+
+
+def synthesize_tone(frequency: float, seconds: float,
+                    sample_rate: int = SAMPLE_RATE) -> np.ndarray:
+    t = np.arange(int(seconds * sample_rate)) / sample_rate
+    return np.sin(2 * np.pi * frequency * t).astype(np.float32)
+
+
+class AudioReadFile(DataSource):
+    """data_sources of .wav paths -> {"audio": (samples,) f32 [-1, 1]}.
+    Stdlib wave + numpy; 16-bit PCM mono/stereo (stereo is averaged)."""
+
+    def read_item(self, stream, item) -> dict:
+        import wave
+        with wave.open(str(item), "rb") as handle:
+            n_channels = handle.getnchannels()
+            width = handle.getsampwidth()
+            raw = handle.readframes(handle.getnframes())
+        if width != 2:
+            raise ValueError(f"{item}: only 16-bit PCM supported")
+        audio = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+        if n_channels > 1:
+            audio = audio.reshape(-1, n_channels).mean(axis=1)
+        return {"audio": audio}
+
+
+class AudioWriteFile(DataTarget):
+    """{"audio"} -> 16-bit PCM mono .wav at data_targets."""
+
+    def process_frame(self, stream, audio):
+        import wave
+        array = np.asarray(audio, np.float32).reshape(-1)
+        path = self.next_target_path(stream)
+        with wave.open(path, "wb") as handle:
+            handle.setnchannels(1)
+            handle.setsampwidth(2)
+            handle.setframerate(
+                int(self.get_parameter("sample_rate", SAMPLE_RATE, stream)))
+            handle.writeframes(
+                (array.clip(-1, 1) * 32767).astype(np.int16).tobytes())
+        return StreamEvent.OKAY, {"audio": audio}
+
+
+class ToneSource(DataSource):
+    """Synthetic audio source: items are [frequency_hz, seconds] pairs --
+    the hermetic stand-in for PE_Microphone* (reference audio_io.py:196+,
+    which needs pyaudio/sounddevice hardware)."""
+
+    def read_item(self, stream, item) -> dict:
+        return {"audio": synthesize_tone(float(item[0]), float(item[1]))}
+
+
+class AudioFraming(PipelineElement):
+    """Sliding-window concatenation of audio chunks (reference
+    PE_AudioFraming, speech_elements.py:54-83: LRU of the last
+    window_count chunks feeding Whisper a longer context)."""
+
+    def process_frame(self, stream, audio):
+        window_count = int(self.get_parameter("window_count", 4, stream))
+        key = f"{self.definition.name}.window"
+        window = stream.variables.setdefault(key, [])
+        window.append(np.asarray(audio, np.float32).reshape(-1))
+        while len(window) > window_count:
+            window.pop(0)
+        return StreamEvent.OKAY, {"audio": np.concatenate(window)}
+
+
+class AudioSample(Sample):
+    """Drop-frame sampler over audio (shared Sample base)."""

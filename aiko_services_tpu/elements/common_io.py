@@ -15,7 +15,7 @@ from pathlib import Path
 
 from ..pipeline import PipelineElement, StreamEvent
 
-__all__ = ["DataSource", "DataTarget", "expand_data_sources"]
+__all__ = ["DataSource", "DataTarget", "Sample", "expand_data_sources"]
 
 
 def expand_data_sources(data_sources) -> list:
@@ -38,8 +38,33 @@ def expand_data_sources(data_sources) -> list:
     return expanded
 
 
+class Sample(PipelineElement):
+    """Pass every sample_rate-th frame, DROP_FRAME otherwise -- the
+    drop-frame test pattern, name-agnostic over its input ports
+    (reference: text_io.py:108-115; Text/Audio/VideoSample are aliases)."""
+
+    def process_frame(self, stream, **inputs):
+        sample_rate = int(self.get_parameter("sample_rate", 1, stream))
+        counter_key = f"{self.definition.name}.counter"
+        counter = stream.variables.get(counter_key, 0)
+        stream.variables[counter_key] = counter + 1
+        if sample_rate > 1 and counter % sample_rate != 0:
+            return StreamEvent.DROP_FRAME, {}
+        return StreamEvent.OKAY, inputs
+
+
 class DataSource(PipelineElement):
     """Subclasses implement read_item(stream, item) -> frame_data dict."""
+
+    def emission_index(self, stream) -> int:
+        """Monotonic per-stream emission counter.  Use this (not
+        stream.frame_id) to seed synthetic sources: frame_id only advances
+        when the pipeline mailbox drains, so a fast generator would reuse
+        the same value across in-flight frames."""
+        key = f"{self.definition.name}.emitted"
+        index = stream.variables.get(key, 0)
+        stream.variables[key] = index + 1
+        return index
 
     def start_stream(self, stream, stream_id):
         data_sources = self.get_parameter("data_sources", None, stream)

@@ -13,7 +13,8 @@ import numpy as np
 from ..pipeline import ComputeElement, StreamEvent
 from .common_io import DataSource
 
-__all__ = ["ArraySource", "JaxScale", "JaxMLP", "ToHost"]
+__all__ = ["ArraySource", "TokenSource", "MultiModalSource", "JaxScale",
+           "JaxMLP", "ToHost"]
 
 
 class ArraySource(DataSource):
@@ -23,8 +24,63 @@ class ArraySource(DataSource):
     def read_item(self, stream, item) -> dict:
         shape = tuple(int(size) for size in item)
         rng = np.random.default_rng(
-            int(self.get_parameter("seed", 0, stream)) + stream.frame_id)
+            int(self.get_parameter("seed", 0, stream))
+            + self.emission_index(stream))
         return {"tensor": rng.standard_normal(shape, dtype=np.float32)}
+
+
+class TokenSource(DataSource):
+    """Emits {"tokens": (B, L) int32} frames: data_sources [[batch, seq]],
+    repeated `count` times (load-generator for LM pipelines/benchmarks)."""
+
+    def start_stream(self, stream, stream_id):
+        items = self.get_parameter("data_sources", [[8, 128]], stream)
+        shapes = [tuple(int(size) for size in item) for item in items]
+        count = int(self.get_parameter("count", 1, stream))
+        name = self.definition.name
+        stream.variables[f"{name}.shapes"] = shapes
+        stream.variables[f"{name}.remaining"] = count
+        rate = self.get_parameter("rate", None, stream)
+        self.create_frames(stream, self._generate,
+                           rate=float(rate) if rate else None)
+        return StreamEvent.OKAY, None
+
+    def _generate(self, stream, frame_id):
+        import time
+        name = self.definition.name
+        remaining = stream.variables[f"{name}.remaining"]
+        if remaining <= 0:
+            return StreamEvent.STOP, {"diagnostic": "count exhausted"}
+        stream.variables[f"{name}.remaining"] = remaining - 1
+        shapes = stream.variables[f"{name}.shapes"]
+        index = self.emission_index(stream)
+        shape = shapes[index % len(shapes)]  # cycle all configured shapes
+        vocab = int(self.get_parameter("vocab_size", 8192, stream))
+        rng = np.random.default_rng(
+            int(self.get_parameter("seed", 0, stream)) + index)
+        # t0 rides the swag so consumers can measure true frame latency
+        # (declare a "t0" output port to propagate it)
+        return StreamEvent.OKAY, {
+            "tokens": rng.integers(0, vocab, shape, dtype=np.int32),
+            "t0": time.time()}
+
+
+class MultiModalSource(DataSource):
+    """Emits {"audio", "image"} frames: items are [frequency_hz, seconds]
+    tone specs plus a synthetic image (parameter image_shape, default
+    [3, 32, 32]) -- the hermetic driver for multi-modal pipelines.
+    Composes audio_io.synthesize_tone + image_io.synthesize_image."""
+
+    def read_item(self, stream, item) -> dict:
+        from .audio_io import synthesize_tone
+        from .image_io import synthesize_image
+        shape = self.get_parameter("image_shape", [3, 32, 32], stream)
+        seed = (int(self.get_parameter("seed", 0, stream))
+                + self.emission_index(stream))
+        return {
+            "audio": synthesize_tone(float(item[0]), float(item[1])),
+            "image": synthesize_image(shape, seed),
+        }
 
 
 class JaxScale(ComputeElement):

@@ -1,0 +1,140 @@
+# Image I/O elements.
+#
+# Capability parity with the reference image elements (reference:
+# src/aiko_services/elements/media/image_io.py:82-255: ImageReadFile (PIL),
+# ImageResize, ImageOverlay (cv2 boxes/labels over the YOLO "overlay"
+# contract), ImageWriteFile, ImageOutput).  TPU-first differences: images
+# travel as float32/uint8 arrays (CHW for compute elements), resize runs as
+# jax.image on device, and ImageOverlay consumes the on-device detections
+# dict from elements.ml.Detector, transferring only the small box tensors.
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..pipeline import PipelineElement, StreamEvent
+from ..utils import get_logger
+from .common_io import DataSource, DataTarget
+
+__all__ = ["ImageReadFile", "ImageResize", "ImageOverlay",
+           "ImageWriteFile", "ImageOutput", "ImageSource",
+           "synthesize_image"]
+
+_LOGGER = get_logger("image_io")
+
+
+def synthesize_image(shape, seed: int) -> np.ndarray:
+    """Deterministic random image (C, H, W) f32 in [0, 1]."""
+    rng = np.random.default_rng(int(seed))
+    return rng.random(tuple(int(size) for size in shape),
+                      dtype=np.float32)
+
+
+class ImageReadFile(DataSource):
+    """data_sources of image paths -> {"image": (3, H, W) f32 [0,1]}."""
+
+    def read_item(self, stream, item) -> dict:
+        from PIL import Image
+        with Image.open(item) as handle:
+            array = np.asarray(handle.convert("RGB"), np.float32) / 255.0
+        return {"image": array.transpose(2, 0, 1)}
+
+
+class ImageSource(DataSource):
+    """Synthetic image source: items are [channels, height, width] shapes
+    (deterministic, seeded) -- the hermetic stand-in for cameras."""
+
+    def read_item(self, stream, item) -> dict:
+        seed = (int(self.get_parameter("seed", 0, stream))
+                + self.emission_index(stream))
+        return {"image": synthesize_image(item, seed)}
+
+
+class ImageResize(PipelineElement):
+    """Resize to (resize_height, resize_width) on device via jax.image
+    (reference ImageResize uses PIL on host, image_io.py:119-138)."""
+
+    def process_frame(self, stream, image):
+        import jax
+        import jax.numpy as jnp
+        height = int(self.get_parameter("resize_height", 256, stream))
+        width = int(self.get_parameter("resize_width", 256, stream))
+        image = jnp.asarray(image)
+        batched = image.ndim == 4
+        if not batched:
+            image = image[None]
+        resized = jax.image.resize(
+            image, (image.shape[0], image.shape[1], height, width),
+            method="bilinear")
+        return StreamEvent.OKAY, {
+            "image": resized if batched else resized[0]}
+
+
+class ImageOverlay(PipelineElement):
+    """Draw detection rectangles/labels onto the image (host-side, like
+    the reference's cv2 overlay consumer, image_io.py:97-163).  Expects the
+    Detector element's detections dict; emits the annotated image plus the
+    reference-shaped overlay dict."""
+
+    def process_frame(self, stream, image, detections):
+        image_np = np.asarray(image)
+        if image_np.ndim == 4:
+            image_np = image_np[0]
+        canvas = np.ascontiguousarray(
+            (image_np.transpose(1, 2, 0) * 255.0).clip(0, 255)
+            .astype(np.uint8))
+        boxes = np.asarray(detections["boxes"])
+        scores = np.asarray(detections["scores"])
+        classes = np.asarray(detections["classes"])
+        valid = np.asarray(detections["valid"])
+        if boxes.ndim == 3:  # batched: first image
+            boxes, scores, classes, valid = (
+                boxes[0], scores[0], classes[0], valid[0])
+        objects, rectangles = [], []
+        try:
+            import cv2
+        except ImportError:  # pragma: no cover
+            cv2 = None
+        for box, score, class_id, ok in zip(boxes, scores, classes, valid):
+            if not ok:
+                continue
+            x0, y0, x1, y1 = (int(v) for v in box)
+            objects.append({"name": f"class_{int(class_id)}",
+                            "confidence": float(score)})
+            rectangles.append({"x": x0, "y": y0,
+                               "w": x1 - x0, "h": y1 - y0})
+            if cv2 is not None:
+                cv2.rectangle(canvas, (x0, y0), (x1, y1), (0, 255, 0), 2)
+                cv2.putText(canvas, f"{int(class_id)}:{score:.2f}",
+                            (x0, max(y0 - 4, 10)),
+                            cv2.FONT_HERSHEY_SIMPLEX, 0.4, (0, 255, 0), 1)
+        overlay = {"objects": objects, "rectangles": rectangles}
+        return StreamEvent.OKAY, {"image": canvas, "overlay": overlay}
+
+
+class ImageWriteFile(DataTarget):
+    """{"image"} -> image files at data_targets (templated paths)."""
+
+    def process_frame(self, stream, image):
+        from PIL import Image
+        array = np.asarray(image)
+        if array.ndim == 4:
+            array = array[0]
+        if array.ndim == 3 and array.shape[0] in (1, 3):  # CHW -> HWC
+            array = array.transpose(1, 2, 0)
+        if array.dtype != np.uint8:
+            array = (array * 255.0).clip(0, 255).astype(np.uint8)
+        path = self.next_target_path(stream)
+        Image.fromarray(array.squeeze()).save(path)
+        return StreamEvent.OKAY, {"image": image}
+
+
+class ImageOutput(PipelineElement):
+    """Log image shapes (reference ImageOutput shows on screen; headless
+    here)."""
+
+    def process_frame(self, stream, image):
+        array = np.asarray(image)
+        _LOGGER.info("%s: image %s %s", self.definition.name,
+                     array.shape, array.dtype)
+        return StreamEvent.OKAY, {"image": image}

@@ -1,0 +1,175 @@
+# ML pipeline elements backed by the in-framework model families
+# (models/), replacing the reference's external-runtime elements:
+# PE_WhisperX (reference: src/aiko_services/examples/speech/
+# speech_elements.py:229-262), PE_LLM (examples/llm/elements_llm.py:137),
+# YoloDetector (examples/yolo/yolo.py:51-87).  Those shell out to
+# torch/CUDA processes; these run jit-compiled JAX on the element's mesh
+# with HBM-resident tensors between stages.
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import (
+    AsrConfig, DetectorConfig, TransformerConfig, count_params, detect,
+    forward, generate, init_asr_params, init_detector_params, init_params,
+    transcribe)
+from ..ops import log_mel_spectrogram
+from ..pipeline import ComputeElement, PipelineElement, StreamEvent
+from ..utils import get_logger
+
+__all__ = ["LMForward", "LMGenerate", "SpeechToText", "Detector",
+           "TokensToText"]
+
+_LOGGER = get_logger("ml_elements")
+
+
+def _transformer_config(element) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=int(element.get_parameter("vocab_size", 8192)),
+        d_model=int(element.get_parameter("d_model", 512)),
+        n_layers=int(element.get_parameter("n_layers", 8)),
+        n_heads=int(element.get_parameter("n_heads", 8)),
+        n_kv_heads=int(element.get_parameter("n_kv_heads", 4)),
+        d_ff=int(element.get_parameter("d_ff", 1536)),
+        max_seq_len=int(element.get_parameter("max_seq_len", 2048)),
+        dtype=str(element.get_parameter("dtype", "bfloat16")),
+    )
+
+
+class LMForward(ComputeElement):
+    """tokens (B, L) -> logits (B, L, V) + per-sequence mean NLL.
+
+    The scoring workhorse: one full causal forward through the flagship
+    transformer on the element's mesh.
+    """
+
+    def setup(self):
+        self.config = _transformer_config(self)
+        params = init_params(
+            self.config,
+            jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+        _LOGGER.info("%s: transformer %.1fM params",
+                     self.definition.name, count_params(params) / 1e6)
+        return params
+
+    def compute(self, state, tokens):
+        logits = forward(state, self.config, tokens)
+        log_probs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        taken = jnp.take_along_axis(
+            log_probs, tokens[:, 1:, None], axis=-1)[..., 0]
+        return {"logits": logits, "nll": -jnp.mean(taken, axis=-1)}
+
+
+class LMGenerate(ComputeElement):
+    """tokens (B, L) prompt -> generated (B, max_new_tokens) greedy tokens.
+
+    Owns its KV cache; generation runs as one jit (prefill + fori_loop
+    decode), so the pipeline mailbox only sees whole completions.
+    """
+
+    def setup(self):
+        self.config = _transformer_config(self)
+        return init_params(
+            self.config,
+            jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+
+    def process_frame(self, stream, tokens):
+        self._ensure_ready()
+        max_new = int(self.get_parameter("max_new_tokens", 32, stream))
+        tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
+        out = generate(self.state, self.config, tokens, max_new)
+        return StreamEvent.OKAY, {"generated": out}
+
+    def compute(self, state, **inputs):  # pragma: no cover
+        raise NotImplementedError("LMGenerate overrides process_frame")
+
+
+# byte-level toy vocabulary shared by SpeechToText and TokensToText:
+# 0=pad 1=sot 2=eot, 3..258 = bytes
+_BYTE_OFFSET = 3
+
+
+class SpeechToText(ComputeElement):
+    """audio (B, samples) 16 kHz f32 -> token ids (B, max_tokens).
+
+    The reference's PE_WhisperX seat (reference speech_elements.py:229-262:
+    5 s windows through WhisperX/CUDA); here the log-mel frontend and the
+    encoder-decoder transformer run as ONE jit on the element's mesh.
+    """
+
+    def setup(self):
+        self.config = AsrConfig(
+            d_model=int(self.get_parameter("d_model", 384)),
+            enc_layers=int(self.get_parameter("enc_layers", 4)),
+            dec_layers=int(self.get_parameter("dec_layers", 4)),
+            n_heads=int(self.get_parameter("n_heads", 6)),
+            vocab_size=int(self.get_parameter("vocab_size", 1024)),
+            max_frames=int(self.get_parameter("max_frames", 1500)),
+            dtype=str(self.get_parameter("dtype", "bfloat16")),
+        )
+        params = init_asr_params(
+            self.config,
+            jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+        _LOGGER.info("%s: ASR %.1fM params", self.definition.name,
+                     count_params(params) / 1e6)
+        return params
+
+    def process_frame(self, stream, audio):
+        self._ensure_ready()
+        audio = jnp.asarray(np.asarray(audio), jnp.float32)
+        if audio.ndim == 1:
+            audio = audio[None]
+        max_tokens = int(self.get_parameter("max_tokens", 32, stream))
+        mel = log_mel_spectrogram(audio)
+        tokens = transcribe(self.state, self.config, mel,
+                            max_tokens=max_tokens)
+        return StreamEvent.OKAY, {"tokens": tokens}
+
+
+class TokensToText(PipelineElement):
+    """tokens (B, T) -> text list[str] via the byte-level toy vocabulary
+    (explicit host boundary: this is where token ids leave the device)."""
+
+    def process_frame(self, stream, tokens):
+        token_array = np.asarray(tokens)
+        texts = []
+        for row in token_array:
+            data = bytes(int(t) - _BYTE_OFFSET for t in row
+                         if t >= _BYTE_OFFSET)
+            texts.append(data.decode("utf-8", errors="replace"))
+        return StreamEvent.OKAY, {"text": texts}
+
+
+class Detector(ComputeElement):
+    """image (B, 3, H, W) [0,1] -> fixed-size detections + the reference
+    overlay contract (reference yolo.py:56-87 emits {"objects": [...],
+    "rectangles": [...]}) -- detections stay on device; the overlay dict is
+    produced lazily by ImageOverlay/host sinks."""
+
+    def setup(self):
+        self.config = DetectorConfig(
+            n_classes=int(self.get_parameter("n_classes", 16)),
+            base_channels=int(self.get_parameter("base_channels", 32)),
+            image_size=int(self.get_parameter("image_size", 256)),
+            max_detections=int(self.get_parameter("max_detections", 32)),
+            score_threshold=float(
+                self.get_parameter("score_threshold", 0.25)),
+            dtype=str(self.get_parameter("dtype", "bfloat16")),
+        )
+        params = init_detector_params(
+            self.config,
+            jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+        _LOGGER.info("%s: detector %.1fM params", self.definition.name,
+                     count_params(params) / 1e6)
+        return params
+
+    def process_frame(self, stream, image):
+        self._ensure_ready()
+        image = jnp.asarray(np.asarray(image), jnp.float32)
+        if image.ndim == 3:
+            image = image[None]
+        detections = detect(self.state, self.config, image)
+        return StreamEvent.OKAY, {"detections": detections}

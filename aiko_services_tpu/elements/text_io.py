@@ -11,7 +11,7 @@ from __future__ import annotations
 from pathlib import Path
 
 from ..pipeline import StreamEvent, PipelineElement
-from .common_io import DataSource, DataTarget
+from .common_io import DataSource, DataTarget, Sample
 
 __all__ = ["TextReadFile", "TextTransform", "TextSample", "TextWriteFile",
            "TextOutput", "TextSource"]
@@ -44,17 +44,8 @@ class TextTransform(PipelineElement):
         return StreamEvent.OKAY, {"text": text}
 
 
-class TextSample(PipelineElement):
+class TextSample(Sample):
     """Pass every Nth frame, drop the rest (reference text_io.py:108-115)."""
-
-    def process_frame(self, stream, text):
-        sample_rate = int(self.get_parameter("sample_rate", 1, stream))
-        counter_key = f"{self.definition.name}.counter"
-        counter = stream.variables.get(counter_key, 0)
-        stream.variables[counter_key] = counter + 1
-        if sample_rate > 1 and counter % sample_rate != 0:
-            return StreamEvent.DROP_FRAME, {}
-        return StreamEvent.OKAY, {"text": text}
 
 
 class TextWriteFile(DataTarget):

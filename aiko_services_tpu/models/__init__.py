@@ -1,0 +1,9 @@
+from .transformer import (                                    # noqa: F401
+    TransformerConfig, init_params, param_specs, forward, init_cache,
+    cache_specs, decode_step, generate, make_train_step, count_params)
+from .asr import (                                            # noqa: F401
+    AsrConfig, init_asr_params, asr_param_specs, encode_audio,
+    decode_tokens, asr_forward, transcribe)
+from .detector import (                                       # noqa: F401
+    DetectorConfig, init_detector_params, detect, detector_forward,
+    decode_boxes, non_max_suppression)

@@ -1,0 +1,157 @@
+# Single-stage anchor-free object detector (YOLO-family architecture).
+#
+# Replaces the reference's YoloDetector element (reference:
+# src/aiko_services/examples/yolo/yolo.py:51-87: Ultralytics YOLOv8 on
+# CUDA emitting an "overlay" dict of objects/rectangles).  Same capability
+# contract -- image in, {objects, rectangles} overlay out -- built as pure
+# JAX: conv backbone to stride 16, anchor-free head (cx, cy, w, h,
+# objectness, classes per cell), box decode and fixed-size NMS all inside
+# one jit so the whole detector fuses on the MXU.
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .layers import conv2d, init_conv
+
+__all__ = ["DetectorConfig", "init_detector_params", "detect",
+           "detector_forward", "decode_boxes", "non_max_suppression"]
+
+
+@dataclass(frozen=True)
+class DetectorConfig:
+    n_classes: int = 16
+    base_channels: int = 32
+    image_size: int = 256          # square input, multiple of 16
+    stride: int = 16
+    max_detections: int = 32
+    score_threshold: float = 0.25
+    iou_threshold: float = 0.45
+    dtype: str = "bfloat16"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def grid_size(self) -> int:
+        return self.image_size // self.stride
+
+
+def init_detector_params(config: DetectorConfig, key) -> dict:
+    keys = jax.random.split(key, 8)
+    c = config.base_channels
+    dtype = config.jnp_dtype
+    return {
+        "stem": init_conv(keys[0], 3, c, 3, dtype),            # /2
+        "stage1": init_conv(keys[1], c, c * 2, 3, dtype),      # /4
+        "block1": init_conv(keys[2], c * 2, c * 2, 3, dtype),
+        "stage2": init_conv(keys[3], c * 2, c * 4, 3, dtype),  # /8
+        "block2": init_conv(keys[4], c * 4, c * 4, 3, dtype),
+        "stage3": init_conv(keys[5], c * 4, c * 8, 3, dtype),  # /16
+        "block3": init_conv(keys[6], c * 8, c * 8, 3, dtype),
+        "head": init_conv(keys[7], c * 8, 5 + config.n_classes, 1, dtype),
+    }
+
+
+def detector_forward(params: dict, config: DetectorConfig, images):
+    """images (B, 3, H, W) in [0, 1] -> raw head (B, 5+C, H/16, W/16)."""
+    x = images.astype(config.jnp_dtype)
+    x = jax.nn.silu(conv2d(params["stem"], x, stride=2))
+    x = jax.nn.silu(conv2d(params["stage1"], x, stride=2))
+    x = x + jax.nn.silu(conv2d(params["block1"], x))
+    x = jax.nn.silu(conv2d(params["stage2"], x, stride=2))
+    x = x + jax.nn.silu(conv2d(params["block2"], x))
+    x = jax.nn.silu(conv2d(params["stage3"], x, stride=2))
+    x = x + jax.nn.silu(conv2d(params["block3"], x))
+    return conv2d(params["head"], x)
+
+
+def decode_boxes(raw, config: DetectorConfig):
+    """raw (B, 5+C, G, G) -> boxes (B, G*G, 4) xyxy in pixels,
+    scores (B, G*G), classes (B, G*G)."""
+    batch, _, grid_h, grid_w = raw.shape
+    raw = raw.astype(jnp.float32)
+    stride = float(config.stride)
+    cell_x = jnp.arange(grid_w, dtype=jnp.float32)[None, :]
+    cell_y = jnp.arange(grid_h, dtype=jnp.float32)[:, None]
+    center_x = (jax.nn.sigmoid(raw[:, 0]) + cell_x) * stride
+    center_y = (jax.nn.sigmoid(raw[:, 1]) + cell_y) * stride
+    width = jnp.exp(jnp.clip(raw[:, 2], -8, 8)) * stride
+    height = jnp.exp(jnp.clip(raw[:, 3], -8, 8)) * stride
+    objectness = jax.nn.sigmoid(raw[:, 4])
+    class_probs = jax.nn.sigmoid(raw[:, 5:])           # (B, C, G, G)
+    class_ids = jnp.argmax(class_probs, axis=1)
+    class_score = jnp.max(class_probs, axis=1)
+    scores = (objectness * class_score).reshape(batch, -1)
+    boxes = jnp.stack([
+        center_x - width / 2, center_y - height / 2,
+        center_x + width / 2, center_y + height / 2], axis=-1)
+    return (boxes.reshape(batch, -1, 4), scores,
+            class_ids.reshape(batch, -1))
+
+
+def _iou(box, boxes):
+    """box (4,) vs boxes (N, 4) xyxy -> (N,) IoU."""
+    inter_lt = jnp.maximum(box[:2], boxes[:, :2])
+    inter_rb = jnp.minimum(box[2:], boxes[:, 2:])
+    inter_wh = jnp.maximum(inter_rb - inter_lt, 0.0)
+    intersection = inter_wh[:, 0] * inter_wh[:, 1]
+    area = (box[2] - box[0]) * (box[3] - box[1])
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    return intersection / jnp.maximum(area + areas - intersection, 1e-9)
+
+
+def non_max_suppression(boxes, scores, classes, config: DetectorConfig):
+    """Fixed-size greedy NMS: (N, 4), (N,), (N,) -> top max_detections
+    (boxes, scores, classes, valid) with suppressed slots zeroed.
+
+    Static shapes throughout (top-k preselect, fori_loop suppress) so the
+    whole thing lives inside jit -- no host round trip per frame.
+    """
+    deficit = config.max_detections - scores.shape[0]
+    if deficit > 0:  # fewer candidates than output slots: zero-pad
+        boxes = jnp.concatenate(
+            [boxes, jnp.zeros((deficit, 4), boxes.dtype)])
+        scores = jnp.concatenate(
+            [scores, jnp.zeros((deficit,), scores.dtype)])
+        classes = jnp.concatenate(
+            [classes, jnp.zeros((deficit,), classes.dtype)])
+    top = min(config.max_detections * 4, scores.shape[0])
+    top_scores, order = jax.lax.top_k(scores, top)
+    top_boxes = boxes[order]
+    top_classes = classes[order]
+
+    def suppress(index, keep_scores):
+        box = top_boxes[index]
+        iou = _iou(box, top_boxes)
+        same_class = top_classes == top_classes[index]
+        later = jnp.arange(top) > index
+        overlapping = (iou > config.iou_threshold) & same_class & later
+        alive = keep_scores[index] > 0.0
+        return jnp.where(overlapping & alive, 0.0, keep_scores)
+
+    kept = jax.lax.fori_loop(0, top, suppress, top_scores)
+    final_scores, final_order = jax.lax.top_k(kept, config.max_detections)
+    valid = final_scores > config.score_threshold
+    return (top_boxes[final_order] * valid[:, None],
+            final_scores * valid,
+            top_classes[final_order] * valid,
+            valid)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def detect(params: dict, config: DetectorConfig, images):
+    """images (B, 3, H, W) -> dict of per-image fixed-size detections:
+    boxes (B, D, 4), scores (B, D), classes (B, D), valid (B, D)."""
+    raw = detector_forward(params, config, images)
+    boxes, scores, classes = decode_boxes(raw, config)
+    nms = jax.vmap(lambda b, s, c: non_max_suppression(b, s, c, config))
+    final_boxes, final_scores, final_classes, valid = nms(
+        boxes, scores, classes)
+    return {"boxes": final_boxes, "scores": final_scores,
+            "classes": final_classes, "valid": valid}

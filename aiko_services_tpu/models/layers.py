@@ -1,0 +1,116 @@
+# Shared neural-net layers as pure functions over parameter pytrees.
+#
+# No reference counterpart: the reference delegates all model math to
+# third-party torch libraries (reference: src/aiko_services/examples/
+# yolo/yolo.py:51, speech/speech_elements.py:233).  Here models are plain
+# JAX -- params are dicts of jax.Array, layers are pure functions, so the
+# whole model jits, shards with NamedSharding, and differentiates without
+# framework machinery.
+#
+# Conventions: weights stored (in_features, out_features) so forward is
+# x @ w; attention heads live in the last-but-one axis (B, H, L, D);
+# everything computes in the dtype of the incoming activations with f32
+# accumulation for matmuls and reductions.
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "dense", "rms_norm", "layer_norm", "rotary_embedding", "apply_rotary",
+    "swiglu", "init_dense", "init_norm", "repeat_kv", "conv2d", "init_conv",
+]
+
+
+def init_dense(key, in_features: int, out_features: int,
+               dtype=jnp.float32) -> dict:
+    scale = 1.0 / np.sqrt(in_features)
+    return {"w": (jax.random.normal(key, (in_features, out_features),
+                                    jnp.float32) * scale).astype(dtype)}
+
+
+def dense(params: dict, x):
+    out = jnp.einsum("...i,io->...o", x, params["w"],
+                     preferred_element_type=jnp.float32)
+    if "b" in params:
+        out = out + params["b"]
+    return out.astype(x.dtype)
+
+
+def init_norm(features: int, dtype=jnp.float32) -> dict:
+    return {"scale": jnp.ones((features,), dtype)}
+
+
+def rms_norm(params: dict, x, eps: float = 1e-6):
+    x_f32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x_f32 * x_f32, axis=-1, keepdims=True)
+                        + eps)
+    return (x_f32 * rms).astype(x.dtype) * params["scale"]
+
+
+def layer_norm(params: dict, x, eps: float = 1e-5):
+    x_f32 = x.astype(jnp.float32)
+    mean = jnp.mean(x_f32, axis=-1, keepdims=True)
+    var = jnp.var(x_f32, axis=-1, keepdims=True)
+    out = (x_f32 - mean) * jax.lax.rsqrt(var + eps)
+    out = out.astype(x.dtype) * params["scale"]
+    if "bias" in params:
+        out = out + params["bias"]
+    return out
+
+
+def rotary_embedding(positions, head_dim: int, theta: float = 10000.0):
+    """positions (..., L) int -> cos/sin tables (..., L, head_dim//2)."""
+    frequencies = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * frequencies
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(x, cos, sin):
+    """x (B, H, L, D); cos/sin (L, D//2) or broadcastable (B, 1, L, D//2)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def swiglu(gate_params: dict, up_params: dict, down_params: dict, x):
+    return dense(down_params,
+                 jax.nn.silu(dense(gate_params, x)) * dense(up_params, x))
+
+
+def repeat_kv(x, repeats: int):
+    """Expand grouped KV heads to full head count: (B, Hkv, L, D) ->
+    (B, Hkv*repeats, L, D)."""
+    if repeats == 1:
+        return x
+    batch, kv_heads, length, dim = x.shape
+    x = jnp.broadcast_to(x[:, :, None],
+                         (batch, kv_heads, repeats, length, dim))
+    return x.reshape(batch, kv_heads * repeats, length, dim)
+
+
+def init_conv(key, in_channels: int, out_channels: int, kernel: int,
+              dtype=jnp.float32, bias: bool = True) -> dict:
+    fan_in = in_channels * kernel * kernel
+    params = {"w": (jax.random.normal(
+        key, (out_channels, in_channels, kernel, kernel), jnp.float32)
+        / np.sqrt(fan_in)).astype(dtype)}
+    if bias:
+        params["b"] = jnp.zeros((out_channels,), dtype)
+    return params
+
+
+def conv2d(params: dict, x, stride: int = 1, padding="SAME"):
+    """x (B, C, H, W), w (O, I, kh, kw) -> (B, O, H', W')."""
+    out = jax.lax.conv_general_dilated(
+        x, params["w"].astype(x.dtype),
+        window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32)
+    if "b" in params:
+        out = out + params["b"].astype(jnp.float32)[None, :, None, None]
+    return out.astype(x.dtype)

@@ -1,0 +1,306 @@
+# Decoder-only transformer LM (Llama-family architecture): the framework's
+# flagship model, replacing the reference's external-process LLM element
+# (reference: src/aiko_services/examples/llm/elements_llm.py:137-179, which
+# shells out to Ollama/OpenAI -- no in-framework model exists).
+#
+# TPU-first design:
+#   - params are a plain pytree; layers are STACKED on a leading axis and
+#     executed with lax.scan (one compiled layer body, not n_layers copies);
+#   - attention runs the Pallas flash kernel for prefill and a masked-cache
+#     einsum for incremental decode; KV cache is a preallocated jax.Array
+#     updated in place via dynamic_update_slice (donated across steps);
+#   - param_specs() gives megatron-style TP over the "model" mesh axis +
+#     FSDP over "fsdp"; activation constraints shard batch on "data" and
+#     sequence on "seq";
+#   - make_train_step() returns a jit-able (params, opt, batch) -> step
+#     with f32 cross-entropy and optax updates, shardable over the mesh.
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.attention import flash_attention
+from .layers import (
+    apply_rotary, dense, init_dense, init_norm, repeat_kv, rms_norm,
+    rotary_embedding)
+
+__all__ = [
+    "TransformerConfig", "init_params", "param_specs", "forward",
+    "init_cache", "cache_specs", "decode_step", "generate",
+    "make_train_step", "count_params",
+]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1536
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+# -- parameters -------------------------------------------------------------
+
+def _init_layer(key, config: TransformerConfig) -> dict:
+    keys = jax.random.split(key, 7)
+    d, hd = config.d_model, config.head_dim
+    dtype = config.jnp_dtype
+    return {
+        "attn_norm": init_norm(d, dtype),
+        "wq": init_dense(keys[0], d, config.n_heads * hd, dtype),
+        "wk": init_dense(keys[1], d, config.n_kv_heads * hd, dtype),
+        "wv": init_dense(keys[2], d, config.n_kv_heads * hd, dtype),
+        "wo": init_dense(keys[3], config.n_heads * hd, d, dtype),
+        "mlp_norm": init_norm(d, dtype),
+        "w_gate": init_dense(keys[4], d, config.d_ff, dtype),
+        "w_up": init_dense(keys[5], d, config.d_ff, dtype),
+        "w_down": init_dense(keys[6], config.d_ff, d, dtype),
+    }
+
+
+def init_params(config: TransformerConfig, key) -> dict:
+    embed_key, *layer_keys = jax.random.split(key, config.n_layers + 1)
+    layers = [_init_layer(k, config) for k in layer_keys]
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *layers)
+    return {
+        "embed": {"w": (jax.random.normal(
+            embed_key, (config.vocab_size, config.d_model), jnp.float32)
+            * 0.02).astype(config.jnp_dtype)},
+        "layers": stacked,
+        "norm_out": init_norm(config.d_model, config.jnp_dtype),
+    }
+
+
+def param_specs(config: TransformerConfig) -> dict:
+    """Megatron TP on 'model' + FSDP on 'fsdp'; stacked-layer leaves carry
+    a leading None for the scan axis.  (Scaling-book recipe: shard the big
+    matmuls, replicate the small norms.)"""
+    layer = {
+        "attn_norm": {"scale": P(None, None)},
+        "wq": {"w": P(None, "fsdp", "model")},
+        "wk": {"w": P(None, "fsdp", "model")},
+        "wv": {"w": P(None, "fsdp", "model")},
+        "wo": {"w": P(None, "model", "fsdp")},
+        "mlp_norm": {"scale": P(None, None)},
+        "w_gate": {"w": P(None, "fsdp", "model")},
+        "w_up": {"w": P(None, "fsdp", "model")},
+        "w_down": {"w": P(None, "model", "fsdp")},
+    }
+    return {
+        "embed": {"w": P(None, "fsdp")},
+        "layers": layer,
+        "norm_out": {"scale": P(None)},
+    }
+
+
+def count_params(params) -> int:
+    return sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))
+
+
+# -- KV cache ---------------------------------------------------------------
+
+def init_cache(config: TransformerConfig, batch: int,
+               max_len: int | None = None) -> dict:
+    max_len = max_len or config.max_seq_len
+    shape = (config.n_layers, batch, config.n_kv_heads, max_len,
+             config.head_dim)
+    return {"k": jnp.zeros(shape, config.jnp_dtype),
+            "v": jnp.zeros(shape, config.jnp_dtype)}
+
+
+def cache_specs() -> dict:
+    return {"k": P(None, "data", "model", None, None),
+            "v": P(None, "data", "model", None, None)}
+
+
+# -- forward ----------------------------------------------------------------
+
+def _attention(config: TransformerConfig, layer, h, cos, sin,
+               cache_k=None, cache_v=None, pos=None):
+    """Returns (output, new_cache_k, new_cache_v).  Without a cache:
+    flash-attention causal prefill.  With a cache: write new K/V at `pos`,
+    masked attention over the whole cache buffer."""
+    batch, length, _ = h.shape
+    hd = config.head_dim
+    q = dense(layer["wq"], h).reshape(
+        batch, length, config.n_heads, hd).transpose(0, 2, 1, 3)
+    k = dense(layer["wk"], h).reshape(
+        batch, length, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = dense(layer["wv"], h).reshape(
+        batch, length, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    repeats = config.n_heads // config.n_kv_heads
+
+    if cache_k is None:
+        out = flash_attention(q, repeat_kv(k, repeats),
+                              repeat_kv(v, repeats), causal=True)
+    else:
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
+        k_full = repeat_kv(cache_k, repeats)
+        v_full = repeat_kv(cache_v, repeats)
+        scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_full,
+                            preferred_element_type=jnp.float32) * scale
+        max_len = cache_k.shape[2]
+        q_pos = pos + jnp.arange(length)[:, None]
+        k_pos = jnp.arange(max_len)[None, :]
+        logits = jnp.where(k_pos <= q_pos, logits, -1e30)
+        weights = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v_full.dtype),
+                         v_full)
+    out = out.transpose(0, 2, 1, 3).reshape(batch, length, -1)
+    return dense(layer["wo"], out), cache_k, cache_v
+
+
+def forward(params: dict, config: TransformerConfig, tokens,
+            cache: dict | None = None, pos: int = 0,
+            activation_specs: bool = False):
+    """tokens (B, L) int32 -> logits (B, L, V) [+ updated cache].
+
+    With cache=None this is a pure causal prefill (training / scoring).
+    With a cache, K/V are written at `pos` (traced or static int) and the
+    updated cache is returned -- the incremental-decode path.
+    """
+    h = jnp.take(params["embed"]["w"], tokens, axis=0)
+    if activation_specs:
+        h = jax.lax.with_sharding_constraint(h, P("data", "seq", None))
+    positions = pos + jnp.arange(tokens.shape[1])
+    cos, sin = rotary_embedding(positions, config.head_dim,
+                                config.rope_theta)
+    cos, sin = cos[None, None], sin[None, None]  # (1, 1, L, hd/2)
+
+    def layer_step(h, xs):
+        layer, layer_cache = xs
+        attn_out, new_k, new_v = _attention(
+            config, layer, rms_norm(layer["attn_norm"], h, config.norm_eps),
+            cos, sin,
+            cache_k=None if layer_cache is None else layer_cache["k"],
+            cache_v=None if layer_cache is None else layer_cache["v"],
+            pos=pos)
+        h = h + attn_out
+        mlp_in = rms_norm(layer["mlp_norm"], h, config.norm_eps)
+        mlp_out = dense(
+            layer["w_down"],
+            jax.nn.silu(dense(layer["w_gate"], mlp_in))
+            * dense(layer["w_up"], mlp_in))
+        h = h + mlp_out
+        if activation_specs:
+            h = jax.lax.with_sharding_constraint(h, P("data", "seq", None))
+        new_cache = (None if new_k is None
+                     else {"k": new_k, "v": new_v})
+        return h, new_cache
+
+    if cache is None:
+        h, _ = jax.lax.scan(
+            lambda carry, layer: layer_step(carry, (layer, None)),
+            h, params["layers"])
+        new_cache = None
+    else:
+        h, new_cache = jax.lax.scan(layer_step, h,
+                                    (params["layers"], cache))
+    h = rms_norm(params["norm_out"], h, config.norm_eps)
+    logits = jnp.einsum("bld,vd->blv", h.astype(jnp.float32),
+                        params["embed"]["w"].astype(jnp.float32))
+    if new_cache is None:
+        return logits
+    return logits, new_cache
+
+
+# -- generation -------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def decode_step(params, config: TransformerConfig, cache, token, pos):
+    """One incremental decode step: token (B, 1) at absolute position pos
+    (B-shaped traced int32).  Returns (next_token greedy, logits, cache)."""
+    logits, cache = forward(params, config, token, cache=cache, pos=pos)
+    next_token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    return next_token[:, None], logits, cache
+
+
+@partial(jax.jit, static_argnames=("config", "max_new_tokens"),
+         donate_argnums=(3,))
+def _generate_compiled(params, config: TransformerConfig, prompt, cache,
+                       max_new_tokens: int):
+    """Module-level jit (stable function identity, so repeated generate()
+    calls hit the compile cache): prefill + fori_loop greedy decode."""
+    batch, prompt_len = prompt.shape
+    logits, cache = forward(params, config, prompt, cache=cache, pos=0)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+    out = jnp.zeros((batch, max_new_tokens), jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, first, (0, 0))
+
+    def body(step, carry):
+        out, cache = carry
+        token = jax.lax.dynamic_slice(out, (0, step - 1), (batch, 1))
+        step_logits, cache = forward(params, config, token, cache=cache,
+                                     pos=prompt_len + step - 1)
+        next_token = jnp.argmax(step_logits[:, -1], axis=-1).astype(
+            jnp.int32)[:, None]
+        out = jax.lax.dynamic_update_slice(out, next_token, (0, step))
+        return out, cache
+
+    out, cache = jax.lax.fori_loop(1, max_new_tokens, body, (out, cache))
+    return out
+
+
+def generate(params, config: TransformerConfig, prompt,
+             max_new_tokens: int, cache=None):
+    """Greedy generation: prefill the prompt, then fori_loop decode inside
+    one jit.  Returns (B, max_new_tokens) int32."""
+    batch, prompt_len = prompt.shape
+    if cache is None:
+        cache = init_cache(config, batch,
+                           max_len=prompt_len + max_new_tokens)
+    return _generate_compiled(params, config, prompt, cache,
+                              int(max_new_tokens))
+
+
+# -- training ---------------------------------------------------------------
+
+def make_train_step(config: TransformerConfig, optimizer,
+                    sharded: bool = False):
+    """Returns train_step(params, opt_state, tokens) -> (params, opt_state,
+    loss).  Next-token cross-entropy in f32; jit with donation.  With
+    sharded=True, activation sharding constraints (data/seq) are inserted
+    for mesh execution."""
+
+    def loss_fn(params, tokens):
+        logits = forward(params, config, tokens[:, :-1],
+                         activation_specs=sharded)
+        targets = tokens[:, 1:]
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        taken = jnp.take_along_axis(
+            log_probs, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(taken)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u.astype(p.dtype)), params, updates)
+        return params, opt_state, loss
+
+    return train_step

@@ -1,0 +1,3 @@
+from .audio import (                                          # noqa: F401
+    mel_filterbank, log_mel_spectrogram, SAMPLE_RATE, N_FFT, HOP_LENGTH,
+    N_MELS)

@@ -1,0 +1,82 @@
+# Audio feature ops: log-mel spectrogram as pure jit-able JAX.
+#
+# The reference's speech stack feeds raw 16 kHz chunks to WhisperX, which
+# computes features internally on CUDA (reference: src/aiko_services/
+# examples/speech/speech_elements.py:229-262; audio constants
+# elements/media/audio_io.py:455-460 -- 16 kHz, 5 s chunks).  Here the
+# frontend is explicit, differentiable, and fuses into the encoder's jit.
+#
+# STFT via jnp.fft.rfft over framed windows; mel filterbank built host-side
+# with numpy (static per config) and closed over as a constant.
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["mel_filterbank", "log_mel_spectrogram", "SAMPLE_RATE",
+           "N_FFT", "HOP_LENGTH", "N_MELS"]
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP_LENGTH = 160
+N_MELS = 80
+
+
+def _hz_to_mel(frequency):
+    return 2595.0 * np.log10(1.0 + np.asarray(frequency) / 700.0)
+
+
+def _mel_to_hz(mel):
+    return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+
+
+@functools.lru_cache(maxsize=8)
+def mel_filterbank(sample_rate: int = SAMPLE_RATE, n_fft: int = N_FFT,
+                   n_mels: int = N_MELS) -> np.ndarray:
+    """(n_mels, n_fft//2 + 1) triangular slaney-style filterbank."""
+    n_freqs = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sample_rate / 2, n_freqs)
+    mel_points = np.linspace(_hz_to_mel(0.0), _hz_to_mel(sample_rate / 2),
+                             n_mels + 2)
+    hz_points = _mel_to_hz(mel_points)
+    bank = np.zeros((n_mels, n_freqs), np.float32)
+    for index in range(n_mels):
+        lower, center, upper = hz_points[index:index + 3]
+        up_slope = (fft_freqs - lower) / max(center - lower, 1e-10)
+        down_slope = (upper - fft_freqs) / max(upper - center, 1e-10)
+        bank[index] = np.maximum(0.0, np.minimum(up_slope, down_slope))
+        # slaney area normalization
+        enorm = 2.0 / (upper - lower)
+        bank[index] *= enorm
+    return bank
+
+
+def log_mel_spectrogram(waveform, sample_rate: int = SAMPLE_RATE,
+                        n_fft: int = N_FFT, hop_length: int = HOP_LENGTH,
+                        n_mels: int = N_MELS):
+    """waveform (..., samples) f32 -> log-mel (..., n_mels, frames).
+
+    Whisper-style: hann window, magnitude^2, mel projection, log10 clamped
+    to 8 decades below the peak, scaled to roughly [-1, 1].
+    """
+    waveform = jnp.asarray(waveform, jnp.float32)
+    pad = n_fft // 2
+    padded = jnp.pad(waveform,
+                     [(0, 0)] * (waveform.ndim - 1) + [(pad, pad)],
+                     mode="reflect")
+    n_frames = 1 + (padded.shape[-1] - n_fft) // hop_length
+    frame_starts = jnp.arange(n_frames) * hop_length
+    indices = frame_starts[:, None] + jnp.arange(n_fft)[None, :]
+    frames = padded[..., indices]                  # (..., frames, n_fft)
+    window = jnp.hanning(n_fft).astype(jnp.float32)
+    spectrum = jnp.fft.rfft(frames * window, axis=-1)
+    power = jnp.abs(spectrum) ** 2                 # (..., frames, n_freqs)
+    bank = jnp.asarray(mel_filterbank(sample_rate, n_fft, n_mels))
+    mel = jnp.einsum("...tf,mf->...mt", power, bank)
+    log_mel = jnp.log10(jnp.maximum(mel, 1e-10))
+    log_mel = jnp.maximum(log_mel, jnp.max(log_mel, axis=(-2, -1),
+                                           keepdims=True) - 8.0)
+    return (log_mel + 4.0) / 4.0

@@ -43,8 +43,10 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def attention_reference(q, k, v, causal: bool = False, sm_scale=None):
-    """Plain-XLA softmax attention: the correctness oracle for the kernels."""
+def attention_reference(q, k, v, causal: bool = False, sm_scale=None,
+                        q_offset: int = 0):
+    """Plain-XLA softmax attention: the correctness oracle for the kernels
+    and the backward pass of the custom-VJP flash kernel."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum(
@@ -52,7 +54,7 @@ def attention_reference(q, k, v, causal: bool = False, sm_scale=None):
         preferred_element_type=jnp.float32) * sm_scale
     if causal:
         q_len, k_len = logits.shape[-2], logits.shape[-1]
-        q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len)
+        q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len) + q_offset
         k_pos = jnp.arange(k_len)[None, :]
         logits = jnp.where(k_pos <= q_pos, logits, _NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
@@ -129,9 +131,6 @@ def _pad_seq(x, block: int):
     return pad_axis_to(x, 2, padded)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("causal", "sm_scale", "block_q", "block_k", "q_offset"))
 def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
                     block_q: int = 128, block_k: int = 128,
                     q_offset: int = 0):
@@ -139,6 +138,11 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
 
     q_offset shifts the causal mask for callers whose q shard starts at a
     nonzero global position (ring attention resumes, KV-cached decode).
+
+    Differentiable: forward is the Pallas kernel; the backward pass
+    recomputes attention with the XLA reference (O(L^2) memory in backward
+    only -- the flash memory win applies to inference and the forward pass
+    of training).
     """
     batch, heads, q_len, head_dim = q.shape
     kv_len = k.shape[2]
@@ -146,6 +150,40 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
         sm_scale = 1.0 / math.sqrt(head_dim)
     block_q = min(block_q, max(q_len, 1))
     block_k = min(block_k, max(kv_len, 1))
+    return _flash(q, k, v, bool(causal), float(sm_scale), int(block_q),
+                  int(block_k), int(q_offset))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
+    return _flash_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                       q_offset)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
+    out = _flash_impl(q, k, v, causal, sm_scale, block_q, block_k, q_offset)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, residuals,
+               cotangent):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_reference(
+            q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset),
+        q, k, v)
+    return vjp(cotangent)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "block_q", "block_k", "q_offset"))
+def _flash_impl(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
+    batch, heads, q_len, head_dim = q.shape
+    kv_len = k.shape[2]
 
     q_padded = _pad_seq(q, block_q).reshape(
         batch * heads, -1, head_dim)

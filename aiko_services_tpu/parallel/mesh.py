@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
     "MESH_AXIS_ORDER", "create_mesh", "get_mesh", "named_sharding",
-    "partition_spec", "shard_pytree",
+    "partition_spec", "shard_pytree", "filter_specs",
 ]
 
 # Ordering matters for ICI locality: innermost (fastest-varying) axes get
@@ -143,3 +143,31 @@ def shard_pytree(tree, mesh: Mesh, specs):
             is_leaf=lambda leaf: (leaf is None
                                   or isinstance(leaf, (PartitionSpec, list))))
     return jax.device_put(tree, shardings)
+
+
+def filter_specs(specs, mesh: Mesh):
+    """Drop axis names a mesh doesn't have from a pytree of PartitionSpecs.
+
+    Model code publishes specs over the full axis vocabulary (data/fsdp/
+    seq/model); a deployment that collapses an axis (e.g. no FSDP on a
+    single host) filters rather than rewriting every spec.
+    """
+    names = set(mesh.axis_names)
+
+    def _filter_entry(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(name for name in entry if name in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    def _filter(spec):
+        spec = partition_spec(spec)
+        return PartitionSpec(*(_filter_entry(entry) for entry in spec))
+
+    return jax.tree_util.tree_map(
+        _filter, specs,
+        is_leaf=lambda leaf: (leaf is None
+                              or isinstance(leaf, (PartitionSpec, list,
+                                                   str))))

@@ -1,0 +1,186 @@
+# ML + media element tests: each model family behind a pipeline element,
+# then the flagship 3-stage multi-modal pipeline (speech -> LLM, vision ->
+# detections in one graph) -- tiny configs on CPU.
+
+import queue
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.transport import reset_brokers
+
+ELEMENTS = "aiko_services_tpu.elements"
+
+TINY_ASR = {"d_model": 32, "enc_layers": 1, "dec_layers": 1, "n_heads": 2,
+            "vocab_size": 300, "max_frames": 64, "dtype": "float32",
+            "max_tokens": 4}
+TINY_LM = {"vocab_size": 300, "d_model": 32, "n_layers": 1, "n_heads": 2,
+           "n_kv_heads": 1, "d_ff": 64, "dtype": "float32"}
+TINY_DET = {"n_classes": 4, "base_channels": 4, "image_size": 32,
+            "max_detections": 4, "dtype": "float32"}
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+def local(class_name):
+    return {"local": {"module": ELEMENTS, "class_name": class_name}}
+
+
+def run_frames(definition, count=1, timeout=120):
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s", queue_response=responses, grace_time=300)
+    results = [responses.get(timeout=timeout) for _ in range(count)]
+    process.terminate()
+    return results
+
+
+def test_speech_to_text_element():
+    definition = {
+        "name": "asr_pipe",
+        "graph": ["(tone (framing (asr (text))))"],
+        "elements": [
+            {"name": "tone", "output": [{"name": "audio"}],
+             "parameters": {"data_sources": [[440, 0.2], [880, 0.2]]},
+             "deploy": local("ToneSource")},
+            {"name": "framing", "input": [{"name": "audio"}],
+             "output": [{"name": "audio"}],
+             "parameters": {"window_count": 2},
+             "deploy": local("AudioFraming")},
+            {"name": "asr", "input": [{"name": "audio"}],
+             "output": [{"name": "tokens"}],
+             "parameters": TINY_ASR, "deploy": local("SpeechToText")},
+            {"name": "text", "input": [{"name": "tokens"}],
+             "output": [{"name": "text"}],
+             "deploy": local("TokensToText")},
+        ],
+    }
+    results = run_frames(definition, count=2)
+    for _, _, outputs in results:
+        assert isinstance(outputs["text"], list)
+        assert np.asarray(outputs["tokens"]).shape == (1, 4)
+
+
+def test_detector_element_and_overlay():
+    definition = {
+        "name": "detect_pipe",
+        "graph": ["(camera (detector (overlay)))"],
+        "elements": [
+            {"name": "camera", "output": [{"name": "image"}],
+             "parameters": {"data_sources": [[3, 32, 32]]},
+             "deploy": local("ImageSource")},
+            {"name": "detector", "input": [{"name": "image"}],
+             "output": [{"name": "detections"}],
+             "parameters": TINY_DET, "deploy": local("Detector")},
+            {"name": "overlay",
+             "input": [{"name": "image"}, {"name": "detections"}],
+             "output": [{"name": "image"}, {"name": "overlay"}],
+             "deploy": local("ImageOverlay")},
+        ],
+    }
+    [(_, _, outputs)] = run_frames(definition)
+    assert outputs["image"].dtype == np.uint8
+    assert set(outputs["overlay"]) == {"objects", "rectangles"}
+    for obj in outputs["overlay"]["objects"]:
+        assert obj["confidence"] > 0
+
+
+def test_three_stage_multimodal_pipeline():
+    """The flagship shape (BASELINE.md config 5 analogue): speech -> ASR
+    tokens -> LLM scoring while vision -> detector runs in the same graph,
+    everything device-resident between elements."""
+    definition = {
+        "name": "flagship",
+        "graph": ["(sources (asr (lm)) (detector))"],
+        "elements": [
+            {"name": "sources",
+             "output": [{"name": "audio"}, {"name": "image"}],
+             "parameters": {"data_sources": [[440, 0.2]]},
+             "deploy": local("MultiModalSource")},
+            {"name": "asr", "input": [{"name": "audio"}],
+             "output": [{"name": "tokens"}],
+             "parameters": TINY_ASR, "deploy": local("SpeechToText")},
+            {"name": "lm", "input": [{"name": "tokens"}],
+             "output": [{"name": "logits"}, {"name": "nll"}],
+             "parameters": TINY_LM, "deploy": local("LMForward")},
+            {"name": "detector", "input": [{"name": "image"}],
+             "output": [{"name": "detections"}],
+             "parameters": TINY_DET, "deploy": local("Detector")},
+        ],
+    }
+    [(_, frame, outputs)] = run_frames(definition)
+    assert np.isfinite(np.asarray(outputs["nll"])).all()
+    assert "detections" in outputs
+    assert {"time_asr", "time_lm", "time_detector"} <= set(frame.metrics)
+
+
+def test_image_read_write_roundtrip(tmp_path):
+    from PIL import Image
+    source_path = tmp_path / "in.png"
+    target_path = tmp_path / "out_{}.png"
+    Image.fromarray(
+        (np.random.default_rng(0).random((16, 16, 3)) * 255)
+        .astype(np.uint8)).save(source_path)
+    definition = {
+        "name": "image_pipe",
+        "graph": ["(read (resize (write)))"],
+        "elements": [
+            {"name": "read", "output": [{"name": "image"}],
+             "parameters": {"data_sources": [str(source_path)]},
+             "deploy": local("ImageReadFile")},
+            {"name": "resize", "input": [{"name": "image"}],
+             "output": [{"name": "image"}],
+             "parameters": {"resize_height": 8, "resize_width": 8},
+             "deploy": local("ImageResize")},
+            {"name": "write", "input": [{"name": "image"}],
+             "output": [{"name": "image"}],
+             "parameters": {"data_targets": [str(target_path)]},
+             "deploy": local("ImageWriteFile")},
+        ],
+    }
+    run_frames(definition)
+    with Image.open(tmp_path / "out_0.png") as result:
+        assert result.size == (8, 8)
+
+
+def test_audio_wav_roundtrip(tmp_path):
+    target = tmp_path / "tone.wav"
+    definition = {
+        "name": "audio_pipe",
+        "graph": ["(tone (write))"],
+        "elements": [
+            {"name": "tone", "output": [{"name": "audio"}],
+             "parameters": {"data_sources": [[440, 0.1]]},
+             "deploy": local("ToneSource")},
+            {"name": "write", "input": [{"name": "audio"}],
+             "output": [{"name": "audio"}],
+             "parameters": {"data_targets": [str(target)]},
+             "deploy": local("AudioWriteFile")},
+        ],
+    }
+    run_frames(definition)
+    definition2 = {
+        "name": "audio_read",
+        "graph": ["(read (sample))"],
+        "elements": [
+            {"name": "read", "output": [{"name": "audio"}],
+             "parameters": {"data_sources": [str(target)]},
+             "deploy": local("AudioReadFile")},
+            {"name": "sample", "input": [{"name": "audio"}],
+             "output": [{"name": "audio"}],
+             "deploy": local("AudioSample")},
+        ],
+    }
+    [(_, _, outputs)] = run_frames(definition2)
+    audio = np.asarray(outputs["audio"])
+    assert audio.shape == (1600,)
+    assert 0.5 < np.abs(audio).max() <= 1.0
