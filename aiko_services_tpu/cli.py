@@ -1,0 +1,108 @@
+# Console entry points.
+#
+# Capability parity with the reference's console scripts (reference:
+# pyproject.toml:60-64 -- aiko_registrar, aiko_pipeline, aiko_dashboard,
+# plus storage/recorder mains): one click group, `python -m
+# aiko_services_tpu <command>`.
+
+from __future__ import annotations
+
+import click
+
+
+@click.group()
+def main() -> None:
+    """aiko_services_tpu: TPU-native distributed ML pipeline framework."""
+
+
+@main.command()
+@click.option("--name", default="registrar")
+@click.option("--transport", default=None,
+              help="loopback | mqtt | null (default: auto from env)")
+def registrar(name: str, transport: str | None) -> None:
+    """Run a service-discovery registrar."""
+    from .runtime import Process, Registrar
+    process = Process(transport_kind=transport)
+    Registrar(process, name=name)
+    process.run()
+
+
+@main.command()
+@click.argument("definition", type=click.Path(exists=True))
+@click.option("--name", default=None)
+@click.option("--transport", default=None)
+@click.option("--stream-id", default=None,
+              help="Create this stream immediately")
+@click.option("--stream-parameters", default="{}",
+              help="JSON stream parameters")
+@click.option("--frame-data", default=None,
+              help="JSON frame data posted to the created stream")
+@click.option("--grace-time", default=60.0)
+def pipeline(definition: str, name: str | None, transport: str | None,
+             stream_id: str | None, stream_parameters: str,
+             frame_data: str | None, grace_time: float) -> None:
+    """Create and run a pipeline from a JSON definition (reference
+    `aiko_pipeline create`, pipeline.py:1444-1528)."""
+    import json
+
+    from .pipeline import create_pipeline
+    from .runtime import Process
+    process = Process(transport_kind=transport)
+    pipeline_instance = create_pipeline(process, definition, name=name)
+    if stream_id is not None:
+        pipeline_instance.create_stream(
+            stream_id, parameters=json.loads(stream_parameters),
+            grace_time=grace_time)
+        if frame_data is not None:
+            pipeline_instance.process_frame(
+                {"stream_id": stream_id}, json.loads(frame_data))
+    process.run()
+
+
+@main.command()
+@click.option("--name", default="storage")
+@click.option("--database", default="storage.db")
+@click.option("--transport", default=None)
+def storage(name: str, database: str, transport: str | None) -> None:
+    """Run a sqlite storage service."""
+    from .runtime import Process, Storage
+    process = Process(transport_kind=transport)
+    Storage(process, name=name, database_path=database)
+    process.run()
+
+
+@main.command()
+@click.option("--name", default="recorder")
+@click.option("--topic", default=None, help="Log topic pattern")
+@click.option("--transport", default=None)
+def recorder(name: str, topic: str | None, transport: str | None) -> None:
+    """Run a log-aggregation recorder service."""
+    from .runtime import Process, Recorder
+    process = Process(transport_kind=transport)
+    Recorder(process, name=name, log_topic_pattern=topic)
+    process.run()
+
+
+@main.command()
+@click.option("--transport", default=None)
+@click.option("--snapshot", is_flag=True,
+              help="Print one services-table snapshot and exit")
+@click.option("--wait", default=3.0,
+              help="Seconds to wait for discovery in snapshot mode")
+def dashboard(transport: str | None, snapshot: bool, wait: float) -> None:
+    """Service dashboard: curses TUI, or --snapshot for plain text."""
+    from .dashboard import run_dashboard
+    run_dashboard(transport_kind=transport, snapshot=snapshot, wait=wait)
+
+
+@main.command()
+def bench() -> None:
+    """Run the standard benchmark (one JSON line)."""
+    import runpy
+    from pathlib import Path
+    bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+    runpy.run_path(str(bench_path), run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

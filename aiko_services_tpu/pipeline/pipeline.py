@@ -125,7 +125,9 @@ class Pipeline(Actor):
 
     def _watch_remote(self, remote: RemoteElement) -> None:
         if self._services_cache is None:
-            self._services_cache = ServicesCache(self.process)
+            from ..runtime.share import services_cache_create_singleton
+            self._services_cache = services_cache_create_singleton(
+                self.process)
         service_filter = ServiceFilter(
             **remote.definition.deploy_remote["service_filter"])
 
@@ -156,7 +158,8 @@ class Pipeline(Actor):
 
     def create_stream(self, stream_id, parameters=None,
                       grace_time=DEFAULT_GRACE_TIME, topic_response=None,
-                      queue_response=None, graph_path=None) -> Stream | None:
+                      queue_response=None, graph_path=None,
+                      first_frame_id: int = 0) -> Stream | None:
         stream_id = str(stream_id)
         if stream_id in self.streams:
             return self.streams[stream_id]
@@ -173,6 +176,9 @@ class Pipeline(Actor):
             stream_id=stream_id, parameters=parameters or {},
             topic_response=topic_response or None,
             queue_response=queue_response, graph_path=graph_path)
+        # cursor must be set BEFORE start_stream: DataSources may begin
+        # generating frames the moment they start (checkpoint resume)
+        stream.frame_id = int(first_frame_id)
         self.streams[stream_id] = stream
         self._stream_leases[stream_id] = Lease(
             self.process.event, grace_time, stream_id,
@@ -465,6 +471,48 @@ class Pipeline(Actor):
         if self.ec_producer is not None:
             self.ec_producer.update("stream_count", len(self.streams))
             self.ec_producer.update("frame_count", self._frame_count)
+
+    # -- checkpoint / resume (no reference counterpart: SURVEY.md section 5
+    # "Checkpoint/resume: absent"; required for preemptible TPU recovery) --
+
+    def checkpoint(self, checkpointer, step: int):
+        """Persist every ComputeElement's device state plus per-stream
+        frame cursors."""
+        from .tpu_element import ComputeElement
+        states = {
+            name: element.state
+            for name, element in self.elements.items()
+            if isinstance(element, ComputeElement)
+            and element.state is not None}
+        cursors = {
+            stream_id: {"frame_id": stream.frame_id,
+                        "parameters": stream.parameters}
+            for stream_id, stream in self.streams.items()}
+        return checkpointer.save(
+            step, states,
+            metadata={"pipeline": self.definition.name,
+                      "streams": cursors})
+
+    def restore_checkpoint(self, checkpointer, step: int | None = None):
+        """Restore element states; returns the metadata dict (callers
+        recreate streams from metadata["streams"] cursors)."""
+        from .tpu_element import ComputeElement
+        states, metadata = checkpointer.restore(step)
+        if states:
+            for name, state in states.items():
+                element = self.elements.get(name)
+                if isinstance(element, ComputeElement):
+                    element.restore_state(state)
+        for stream_id, cursor in (metadata.get("streams") or {}).items():
+            frame_id = int(cursor.get("frame_id", 0))
+            stream = self.streams.get(stream_id)
+            if stream is None:
+                self.create_stream(stream_id,
+                                   parameters=cursor.get("parameters"),
+                                   first_frame_id=frame_id)
+            elif stream.frame_id < frame_id:
+                stream.frame_id = frame_id
+        return metadata
 
     def stop(self) -> None:
         for stream_id in list(self.streams):

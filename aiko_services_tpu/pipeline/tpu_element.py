@@ -105,10 +105,11 @@ class ComputeElement(PipelineElement):
     def _ensure_ready(self):
         if self._compiled is not None:
             return
-        state = self.setup()
-        if state is not None and self.mesh is not None:
-            state = shard_pytree(state, self.mesh, self._state_spec)
-        self.state = state
+        if self.state is None:  # restore_state may have installed it
+            state = self.setup()
+            if state is not None and self.mesh is not None:
+                state = shard_pytree(state, self.mesh, self._state_spec)
+            self.state = state
         signature = inspect.signature(self.compute)
         self._accepts_lengths = "lengths" in signature.parameters
 
@@ -181,6 +182,19 @@ class ComputeElement(PipelineElement):
                     sliced_axes.add(axis)
             result[name] = value
         return result
+
+    def restore_state(self, state) -> None:
+        """Install checkpointed state (numpy pytree from Checkpointer),
+        re-placing it on the element's mesh.  Installed BEFORE
+        _ensure_ready so setup() never allocates a fresh params pytree
+        that would double peak HBM on the restore path."""
+        if state is not None:
+            if self.mesh is not None:
+                state = shard_pytree(state, self.mesh, self._state_spec)
+            else:
+                state = jax.tree_util.tree_map(jnp.asarray, state)
+            self.state = state
+        self._ensure_ready()
 
     def process_frame(self, stream: Stream, **inputs) -> tuple:
         self._ensure_ready()

@@ -9,5 +9,12 @@ from .service import (                                        # noqa: F401
 from .process import Process, default_process                 # noqa: F401
 from .actor import Actor, ActorMessage, ActorTopic            # noqa: F401
 from .proxy import make_proxy, get_public_methods, RemoteProxy  # noqa: F401
-from .share import ECProducer, ECConsumer, ServicesCache      # noqa: F401
+from .share import (                                          # noqa: F401
+    ECProducer, ECConsumer, ServicesCache,
+    services_cache_create_singleton)
 from .registrar import Registrar                              # noqa: F401
+from .state import StateMachine, StateMachineError            # noqa: F401
+from .process_manager import ProcessManager                   # noqa: F401
+from .lifecycle import LifeCycleManager, LifeCycleClient      # noqa: F401
+from .storage import Storage, do_command, do_request          # noqa: F401
+from .recorder import Recorder                                # noqa: F401

@@ -147,6 +147,11 @@ class Actor(Service):
     def publish_out(self, command: str, parameters=()) -> None:
         self.process.publish(self.topic_out, generate(command, parameters))
 
+    def terminate(self) -> None:
+        """Wire-invocable kill: "(terminate)" on /in tears down the whole
+        process (reference dashboard kill, dashboard.py:368-377)."""
+        self.process.terminate()
+
     def stop(self) -> None:
         engine = self.process.event
         engine.remove_mailbox_handler(self._mailbox_control)

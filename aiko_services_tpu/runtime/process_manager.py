@@ -1,0 +1,103 @@
+# ProcessManager: spawn and reap OS child processes.
+#
+# Capability parity with the reference ProcessManager (reference:
+# src/aiko_services/main/process_manager.py:48-110): Popen children keyed
+# by id, bare module names resolved to file paths via importlib, a
+# background poll thread reaping exits into a process_exit_handler.
+
+from __future__ import annotations
+
+import importlib.util
+import subprocess
+import sys
+import threading
+
+from ..utils import get_logger
+
+__all__ = ["ProcessManager"]
+
+_LOGGER = get_logger("process_manager")
+_POLL_INTERVAL = 0.2  # reference process_manager.py poll cadence
+
+
+class ProcessManager:
+    def __init__(self, process_exit_handler=None):
+        self.process_exit_handler = process_exit_handler
+        self.processes: dict = {}   # id -> {"process": Popen, "command":..}
+        self._lock = threading.Lock()
+        self._monitor: threading.Thread | None = None
+        self._terminated = False
+
+    @staticmethod
+    def resolve_command(command: str) -> str:
+        """Bare module name -> source file path (reference
+        process_manager.py:63-80); paths and executables pass through."""
+        if "/" in command or command.endswith(".py"):
+            return command
+        specification = importlib.util.find_spec(command)
+        if specification is not None and specification.origin:
+            return specification.origin
+        return command
+
+    def spawn(self, process_id, command: str, arguments=(),
+              use_interpreter: bool = True):
+        command_path = self.resolve_command(command)
+        argv = ([sys.executable, command_path] if use_interpreter
+                else [command_path])
+        argv += [str(argument) for argument in arguments]
+        child = subprocess.Popen(argv)
+        with self._lock:
+            self.processes[process_id] = {
+                "process": child, "command": command_path}
+            if self._monitor is None:
+                self._monitor = threading.Thread(
+                    target=self._monitor_loop, name="process-manager",
+                    daemon=True)
+                self._monitor.start()
+        _LOGGER.info("Spawned %s: pid %d: %s",
+                     process_id, child.pid, " ".join(argv))
+        return child
+
+    def kill(self, process_id, timeout: float = 5.0) -> None:
+        with self._lock:
+            record = self.processes.pop(process_id, None)
+        if record is None:
+            return
+        child = record["process"]
+        child.terminate()
+        try:
+            child.wait(timeout)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait()
+
+    def kill_all(self) -> None:
+        for process_id in list(self.processes):
+            self.kill(process_id)
+
+    def __contains__(self, process_id) -> bool:
+        return process_id in self.processes
+
+    def _monitor_loop(self) -> None:
+        import time
+        while not self._terminated:
+            exited = []
+            with self._lock:
+                for process_id, record in list(self.processes.items()):
+                    return_code = record["process"].poll()
+                    if return_code is not None:
+                        exited.append((process_id, record, return_code))
+                        del self.processes[process_id]
+            for process_id, record, return_code in exited:
+                _LOGGER.info("Process %s exited: %d",
+                             process_id, return_code)
+                if self.process_exit_handler:
+                    try:
+                        self.process_exit_handler(process_id, return_code)
+                    except Exception:
+                        _LOGGER.exception("process_exit_handler failed")
+            time.sleep(_POLL_INTERVAL)
+
+    def terminate(self) -> None:
+        self._terminated = True
+        self.kill_all()

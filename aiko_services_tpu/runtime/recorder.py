@@ -1,0 +1,61 @@
+# Recorder: aggregate distributed log topics for observability.
+#
+# Capability parity with the reference Recorder (reference:
+# src/aiko_services/main/recorder.py:50-96): subscribes to a log-topic
+# wildcard (default "{namespace}/+/+/+/log"), keeps an LRU of per-topic
+# ring buffers, and republishes counts through its ECProducer so dashboards
+# can watch live.
+
+from __future__ import annotations
+
+from collections import deque
+
+from ..utils import LRUCache, get_logger
+from .actor import Actor
+from .share import ECProducer
+
+__all__ = ["Recorder"]
+
+_LOGGER = get_logger("recorder")
+SERVICE_PROTOCOL_RECORDER = "recorder:0"
+RING_SIZE = 128          # reference logger ring, utilities/logger.py:137
+TOPIC_CACHE_SIZE = 64
+
+
+class Recorder(Actor):
+    def __init__(self, process, name: str = "recorder",
+                 log_topic_pattern: str | None = None,
+                 ring_size: int = RING_SIZE):
+        super().__init__(process, name,
+                         protocol=SERVICE_PROTOCOL_RECORDER)
+        self.log_topic_pattern = (
+            log_topic_pattern or f"{process.namespace}/+/+/+/log")
+        self.ring_size = ring_size
+        self.topic_rings = LRUCache(TOPIC_CACHE_SIZE)
+        self.share.update({"topic_count": 0, "record_count": 0})
+        ECProducer(self)
+        self._record_count = 0
+        self.add_message_handler(self._log_handler, self.log_topic_pattern)
+
+    def _log_handler(self, topic: str, payload: str) -> None:
+        ring = self.topic_rings.get(topic)
+        if ring is None:
+            ring = deque(maxlen=self.ring_size)
+            self.topic_rings.put(topic, ring)
+            self.ec_producer.update("topic_count", len(self.topic_rings))
+        ring.append(payload)
+        self._record_count += 1
+        if self._record_count % 16 == 0:  # rate-limit EC chatter
+            self.ec_producer.update("record_count", self._record_count)
+
+    def records(self, topic: str) -> list:
+        ring = self.topic_rings.get(topic)
+        return list(ring) if ring is not None else []
+
+    def topics(self) -> list:
+        return list(self.topic_rings.keys())
+
+    def stop(self) -> None:
+        self.remove_message_handler(self._log_handler,
+                                    self.log_topic_pattern)
+        super().stop()

@@ -133,6 +133,9 @@ class ServiceFields:
 def _field_match(required, actual) -> bool:
     if required in ("*", None):
         return True
+    if isinstance(required, str) and ("*" in required or "?" in required):
+        import fnmatch
+        return fnmatch.fnmatchcase(str(actual), required)
     return required == actual
 
 

@@ -21,7 +21,8 @@ from .connection import ConnectionState
 from .lease import Lease
 from .service import ServiceFields, ServiceFilter, Services
 
-__all__ = ["ECProducer", "ECConsumer", "ServicesCache"]
+__all__ = ["ECProducer", "ECConsumer", "ServicesCache",
+           "services_cache_create_singleton"]
 
 _LOGGER = get_logger("share")
 _EC_COMMANDS = frozenset(("add", "update", "remove", "share"))
@@ -292,6 +293,22 @@ class ServicesCache:
                 self._notify("remove", fields)
 
     def _notify(self, command: str, fields: ServiceFields) -> None:
-        for service_filter, handler in self._handlers:
+        # copy: handlers may remove themselves while being notified
+        for service_filter, handler in list(self._handlers):
             if service_filter.matches(fields):
                 handler(command, fields)
+
+
+_SERVICES_CACHE_SINGLETONS: dict = {}
+
+
+def services_cache_create_singleton(process) -> ServicesCache:
+    """One shared registrar mirror per Process (reference
+    share.py:639-656): repeated do_command/do_request/remote-element use
+    must not accumulate one full cache (plus registrar subscriptions)
+    per call."""
+    cache = _SERVICES_CACHE_SINGLETONS.get(id(process))
+    if cache is None or cache.process is not process:
+        cache = ServicesCache(process)
+        _SERVICES_CACHE_SINGLETONS[id(process)] = cache
+    return cache

@@ -1,0 +1,126 @@
+# Storage service: sqlite-backed key-value actor + the request/response
+# idioms.
+#
+# Capability parity with the reference storage layer (reference:
+# src/aiko_services/main/storage.py:49-103): a sqlite actor and the two
+# generic invocation idioms -- do_command (discover a service by filter,
+# proxy, invoke) and do_request (command + paged "(item_count N)" response
+# collection on a dedicated response topic).
+
+from __future__ import annotations
+
+import json
+import sqlite3
+
+from ..utils import generate, get_logger, parse, parse_number
+from .actor import Actor
+from .proxy import make_proxy
+from .service import ServiceFilter
+from .share import ServicesCache, services_cache_create_singleton
+
+__all__ = ["Storage", "do_command", "do_request"]
+
+_LOGGER = get_logger("storage")
+SERVICE_PROTOCOL_STORAGE = "storage:0"
+
+
+class Storage(Actor):
+    """Key-value store over sqlite.  Commands on /in:
+    (save key value) | (load key response_topic) | (delete key) |
+    (keys response_topic)."""
+
+    def __init__(self, process, name: str = "storage",
+                 database_path: str = ":memory:"):
+        super().__init__(process, name, protocol=SERVICE_PROTOCOL_STORAGE)
+        self._connection = sqlite3.connect(
+            database_path, check_same_thread=False)
+        self._connection.execute(
+            "CREATE TABLE IF NOT EXISTS store "
+            "(key TEXT PRIMARY KEY, value TEXT)")
+        self._connection.commit()
+
+    def save(self, key, value) -> None:
+        self._connection.execute(
+            "INSERT OR REPLACE INTO store (key, value) VALUES (?, ?)",
+            (str(key), json.dumps(value)))
+        self._connection.commit()
+
+    def load(self, key, response_topic) -> None:
+        row = self._connection.execute(
+            "SELECT value FROM store WHERE key = ?",
+            (str(key),)).fetchone()
+        items = [] if row is None else [row[0]]  # stored JSON text
+        self._respond(response_topic, items)
+
+    def delete(self, key) -> None:
+        self._connection.execute(
+            "DELETE FROM store WHERE key = ?", (str(key),))
+        self._connection.commit()
+
+    def keys(self, response_topic) -> None:
+        rows = self._connection.execute(
+            "SELECT key FROM store ORDER BY key").fetchall()
+        self._respond(response_topic, [row[0] for row in rows])
+
+    def _respond(self, response_topic, items) -> None:
+        """items are wire-ready strings (keys, or stored JSON text)."""
+        publish = self.process.publish
+        publish(response_topic, generate("item_count", [len(items)]))
+        for item in items:
+            publish(response_topic, generate("item", [item]))
+
+    def stop(self) -> None:
+        self._connection.close()
+        super().stop()
+
+
+def do_command(process, service_filter: ServiceFilter, command_handler,
+               services_cache: ServicesCache | None = None):
+    """Discover the first service matching the filter, then invoke
+    command_handler(proxy) (reference storage.py:67-81).  Returns the
+    ServicesCache handler so callers may detach it."""
+    cache = services_cache or services_cache_create_singleton(process)
+    invoked = []
+
+    def on_service(command, fields):
+        if command == "add" and not invoked:
+            invoked.append(fields)
+            cache.remove_handler(on_service)  # one-shot
+            command_handler(make_proxy(process, fields.topic_path))
+
+    cache.add_handler(on_service, service_filter)
+    return on_service
+
+
+def do_request(process, service_filter: ServiceFilter, request_handler,
+               response_handler, item_types=("item",),
+               services_cache: ServicesCache | None = None) -> str:
+    """do_command + paged response collection (reference storage.py:87-103):
+    request_handler(proxy, response_topic) issues the command; responses
+    arrive as "(item_count N)" then N item payloads; response_handler(items)
+    fires once all pages arrive.  Returns the response topic."""
+    import itertools
+    sequence = getattr(do_request, "_sequence", None)
+    if sequence is None:
+        sequence = do_request._sequence = itertools.count()
+    response_topic = (f"{process.topic_path_process}/0/request/"
+                      f"{next(sequence)}")
+    collected = []
+    expected = [None]
+
+    def on_response(topic, payload):
+        command, parameters = parse(payload)
+        if command == "item_count" and parameters:
+            expected[0] = int(parse_number(parameters[0], 0))
+        elif command in item_types:
+            collected.append(parameters[0] if len(parameters) == 1
+                             else list(parameters))
+        if expected[0] is not None and len(collected) >= expected[0]:
+            process.remove_message_handler(on_response, response_topic)
+            response_handler(collected)
+
+    process.add_message_handler(on_response, response_topic)
+    do_command(process, service_filter,
+               lambda proxy: request_handler(proxy, response_topic),
+               services_cache=services_cache)
+    return response_topic

@@ -10,3 +10,4 @@ from .timeutil import (                                     # noqa: F401
     epoch_now, epoch_to_iso, iso_to_epoch, monotonic)
 from .logger import get_logger, RingBufferHandler           # noqa: F401
 from .importer import load_module                           # noqa: F401
+from .padding import bucket_length, pad_axis_to             # noqa: F401,E402

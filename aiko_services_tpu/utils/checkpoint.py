@@ -1,0 +1,84 @@
+# Checkpoint/restore for model state and stream cursors.
+#
+# The reference has NO checkpointing (SURVEY.md section 5: "Checkpoint /
+# resume: absent" -- its storage.py is a sqlite skeleton and the registrar
+# history ring is observability, not recovery).  A TPU framework needs it:
+# preemptible TPU VMs lose HBM, so element params, optimizer state, and
+# per-stream frame cursors must round-trip to disk (orbax handles the
+# pytree serialization, sharded arrays included).
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from . import get_logger
+
+__all__ = ["Checkpointer"]
+
+_LOGGER = get_logger("checkpoint")
+
+
+class Checkpointer:
+    """Step-indexed pytree checkpoints + a JSON metadata sidecar.
+
+    save(step, pytree, metadata) / restore(step=None) -> (pytree, metadata);
+    keeps the newest max_to_keep steps.  Works for any JAX pytree: model
+    params, optimizer state, KV caches; metadata carries small JSON state
+    (stream cursors, frame ids, config echoes).
+    """
+
+    def __init__(self, directory, max_to_keep: int = 3):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        import orbax.checkpoint as ocp
+        self._checkpointer = ocp.PyTreeCheckpointer()
+
+    def _step_dir(self, step: int) -> Path:
+        return self.directory / f"step_{step:012d}"
+
+    def steps(self) -> list[int]:
+        found = []
+        for path in self.directory.glob("step_*"):
+            try:
+                found.append(int(path.name.split("_", 1)[1]))
+            except ValueError:
+                continue
+        return sorted(found)
+
+    def save(self, step: int, pytree, metadata: dict | None = None) -> Path:
+        target = self._step_dir(step)
+        if target.exists():
+            import shutil
+            shutil.rmtree(target)
+        self._checkpointer.save(target / "state", pytree)
+        (target / "metadata.json").write_text(
+            json.dumps(metadata or {}, sort_keys=True))
+        self._prune()
+        _LOGGER.info("Checkpoint saved: %s", target)
+        return target
+
+    def restore(self, step: int | None = None):
+        """Returns (pytree, metadata); (None, {}) when nothing exists."""
+        steps = self.steps()
+        if not steps:
+            return None, {}
+        step = steps[-1] if step is None else step
+        target = self._step_dir(step)
+        pytree = self._checkpointer.restore(target / "state")
+        metadata_path = target / "metadata.json"
+        metadata = (json.loads(metadata_path.read_text())
+                    if metadata_path.exists() else {})
+        return pytree, metadata
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def _prune(self) -> None:
+        import shutil
+        steps = self.steps()
+        while len(steps) > self.max_to_keep:
+            victim = self._step_dir(steps.pop(0))
+            shutil.rmtree(victim, ignore_errors=True)

@@ -1,0 +1,145 @@
+# Checkpointer round-trip (params + stream cursors), pipeline-level
+# checkpoint/restore, dashboard model over the loopback broker, CLI smoke.
+
+import queue
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.runtime import Process, Registrar
+from aiko_services_tpu.transport import get_broker, reset_brokers
+from aiko_services_tpu.utils.checkpoint import Checkpointer
+from helpers import wait_for
+
+ELEMENTS = "aiko_services_tpu.elements"
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+def local(class_name):
+    return {"local": {"module": ELEMENTS, "class_name": class_name}}
+
+
+class TestCheckpointer:
+    def test_pytree_roundtrip_and_prune(self, tmp_path):
+        checkpointer = Checkpointer(tmp_path / "ckpt", max_to_keep=2)
+        tree = {"w": jnp.arange(6.0).reshape(2, 3),
+                "nested": {"b": jnp.ones((4,))}}
+        for step in (1, 2, 3):
+            checkpointer.save(step, tree, metadata={"step": step})
+        assert checkpointer.steps() == [2, 3]  # pruned to max_to_keep
+        restored, metadata = checkpointer.restore()
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(6.0).reshape(2, 3))
+        assert metadata == {"step": 3}
+
+    def test_restore_empty(self, tmp_path):
+        checkpointer = Checkpointer(tmp_path / "none")
+        tree, metadata = checkpointer.restore()
+        assert tree is None and metadata == {}
+
+
+class TestPipelineCheckpoint:
+    def _definition(self):
+        return {
+            "name": "ckpt_pipe",
+            "graph": ["(source (mlp (sink)))"],
+            "elements": [
+                {"name": "source", "output": [{"name": "tensor"}],
+                 "parameters": {"data_sources": [[4, 16]]},
+                 "deploy": local("ArraySource")},
+                {"name": "mlp", "input": [{"name": "tensor"}],
+                 "output": [{"name": "tensor"}],
+                 "parameters": {"features": 16, "hidden": 8},
+                 "deploy": local("JaxMLP")},
+                {"name": "sink", "input": [{"name": "tensor"}],
+                 "output": [{"name": "tensor"}],
+                 "deploy": local("ToHost")},
+            ],
+        }
+
+    def test_element_state_and_cursor_roundtrip(self, tmp_path):
+        checkpointer = Checkpointer(tmp_path / "ckpt")
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, self._definition())
+        process.run(in_thread=True)
+        responses = queue.Queue()
+        pipeline.create_stream("s1", queue_response=responses)
+        responses.get(timeout=30)
+        original_w1 = np.asarray(pipeline.elements["mlp"].state["w1"])
+        pipeline.checkpoint(checkpointer, step=7)
+        process.terminate()
+
+        # fresh pipeline restores the same weights + stream cursor
+        reset_brokers()
+        process2 = Process(transport_kind="loopback")
+        pipeline2 = create_pipeline(process2, self._definition())
+        process2.run(in_thread=True)
+        metadata = pipeline2.restore_checkpoint(checkpointer)
+        assert metadata["pipeline"] == "ckpt_pipe"
+        np.testing.assert_array_equal(
+            np.asarray(pipeline2.elements["mlp"].state["w1"]), original_w1)
+        assert "s1" in pipeline2.streams
+        assert pipeline2.streams["s1"].frame_id >= 1
+        process2.terminate()
+
+
+class TestDashboard:
+    def test_model_tracks_services_and_share(self):
+        from aiko_services_tpu.dashboard import (
+            DashboardModel, render_snapshot)
+        registrar_process = Process(transport_kind="loopback")
+        Registrar(registrar_process, search_timeout=0.05)
+        registrar_process.run(in_thread=True)
+
+        worker_process = Process(transport_kind="loopback")
+        from aiko_services_tpu.runtime import Actor, ECProducer
+        worker = Actor(worker_process, "worker")
+        ECProducer(worker)
+        worker_process.run(in_thread=True)
+
+        viewer_process = Process(transport_kind="loopback")
+        model = DashboardModel(viewer_process)
+        viewer_process.run(in_thread=True)
+
+        wait_for(lambda: any("worker" == str(fields.name)
+                             for fields in model.rows.values()),
+                 timeout=10)
+        snapshot = render_snapshot(model)
+        assert "worker" in snapshot and "service(s)" in snapshot
+
+        worker_topic = next(topic for topic, fields in model.rows.items()
+                            if str(fields.name) == "worker")
+        model.select(worker_topic)
+        worker.ec_producer.update("temperature", 42)
+        # EC values cross the S-expression wire as text
+        wait_for(lambda: model.selected_share.get("temperature") == "42",
+                 timeout=10)
+
+        # variable edit flows back to the worker's share
+        model.update_variable("temperature", 7)
+        get_broker().drain()
+        wait_for(lambda: worker.share.get("temperature") == "7",
+                 timeout=10)
+
+        for process in (registrar_process, worker_process, viewer_process):
+            process.terminate()
+
+
+class TestCli:
+    def test_cli_help_lists_commands(self):
+        from click.testing import CliRunner
+        from aiko_services_tpu.cli import main
+        result = CliRunner().invoke(main, ["--help"])
+        assert result.exit_code == 0
+        for command in ("registrar", "pipeline", "storage", "recorder",
+                        "dashboard", "bench"):
+            assert command in result.output

@@ -1,0 +1,221 @@
+# Orchestration layer tests: state machine, process manager, storage +
+# request idioms, recorder, lifecycle manager/client -- hermetic over the
+# loopback broker.
+
+import sys
+import time
+
+import pytest
+
+from aiko_services_tpu.runtime import (
+    LifeCycleClient, LifeCycleManager, ProcessManager, Recorder, Registrar,
+    Process, StateMachine, StateMachineError, Storage, do_request)
+from aiko_services_tpu.runtime.service import ServiceFilter
+from aiko_services_tpu.transport import get_broker, reset_brokers
+from helpers import wait_for
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+class TestStateMachine:
+    class Model:
+        entered = None
+
+        def on_enter_primary(self, **kwargs):
+            self.entered = ("primary", kwargs)
+
+    def _machine(self):
+        model = self.Model()
+        return model, StateMachine(
+            model,
+            states=["start", "primary_search", "primary", "secondary"],
+            transitions=[
+                {"name": "initialize", "source": "start",
+                 "dest": "primary_search"},
+                {"name": "promote", "source": "primary_search",
+                 "dest": "primary"},
+                {"name": "demote", "source": "*", "dest": "secondary"},
+            ],
+            initial="start")
+
+    def test_transitions_and_callbacks(self):
+        model, machine = self._machine()
+        machine.transition("initialize")
+        assert machine.get_state() == "primary_search"
+        machine.transition("promote", reason="timeout")
+        assert model.entered == ("primary", {"reason": "timeout"})
+
+    def test_wildcard_source(self):
+        _, machine = self._machine()
+        machine.transition("demote")
+        assert machine.get_state() == "secondary"
+
+    def test_invalid_transition_raises(self):
+        _, machine = self._machine()
+        with pytest.raises(StateMachineError, match="invalid from"):
+            machine.transition("promote")  # not in primary_search
+
+
+class TestProcessManager:
+    def test_spawn_and_reap(self):
+        exits = []
+        manager = ProcessManager(
+            lambda process_id, code: exits.append((process_id, code)))
+        child = manager.spawn(
+            "sleeper", sys.executable,
+            arguments=["-c", "import time; time.sleep(0.1)"],
+            use_interpreter=False)
+        assert "sleeper" in manager
+        wait_for(lambda: ("sleeper", 0) in exits, timeout=10)
+        assert child.returncode == 0
+        manager.terminate()
+
+    def test_kill(self):
+        manager = ProcessManager()
+        manager.spawn("stuck", sys.executable,
+                      arguments=["-c", "import time; time.sleep(60)"],
+                      use_interpreter=False)
+        start = time.time()
+        manager.kill("stuck")
+        assert time.time() - start < 10
+        assert "stuck" not in manager
+        manager.terminate()
+
+    def test_resolve_command_module(self):
+        path = ProcessManager.resolve_command("json")
+        assert path.endswith("__init__.py")
+
+
+class TestStorage:
+    def test_save_load_keys_delete_via_wire(self):
+        process = Process(transport_kind="loopback")
+        registrar_process = Process(transport_kind="loopback")
+        Registrar(registrar_process, search_timeout=0.05)
+        registrar_process.run(in_thread=True)
+        storage = Storage(process)
+        process.run(in_thread=True)
+
+        # local API
+        storage.save("alpha", {"x": 1})
+        storage.save("beta", [1, 2, 3])
+
+        results = []
+        do_request(
+            process, ServiceFilter(protocol="storage*"),
+            lambda proxy, response_topic: proxy.keys(response_topic),
+            results.append)
+        wait_for(lambda: results, timeout=10)
+        assert results[0] == ["alpha", "beta"]
+
+        loaded = []
+        do_request(
+            process, ServiceFilter(protocol="storage*"),
+            lambda proxy, response_topic: proxy.load(
+                "alpha", response_topic),
+            loaded.append)
+        wait_for(lambda: loaded, timeout=10)
+        import json
+        assert json.loads(loaded[0][0]) == {"x": 1}
+
+        storage.delete("alpha")
+        gone = []
+        do_request(
+            process, ServiceFilter(protocol="storage*"),
+            lambda proxy, response_topic: proxy.load(
+                "alpha", response_topic),
+            gone.append)
+        wait_for(lambda: gone == [[]], timeout=10)
+        process.terminate()
+        registrar_process.terminate()
+
+
+class TestRecorder:
+    def test_log_aggregation(self):
+        process = Process(transport_kind="loopback")
+        recorder = Recorder(process)
+        process.run(in_thread=True)
+        log_topic = f"{process.namespace}/host/123/1/log"
+        for index in range(5):
+            process.publish(log_topic, f"line {index}")
+        get_broker().drain()
+        wait_for(lambda: len(recorder.records(log_topic)) == 5)
+        assert recorder.topics() == [log_topic]
+        assert recorder.records(log_topic)[0] == "line 0"
+        process.terminate()
+
+    def test_ring_bounded(self):
+        process = Process(transport_kind="loopback")
+        recorder = Recorder(process, ring_size=4)
+        process.run(in_thread=True)
+        log_topic = f"{process.namespace}/host/1/1/log"
+        for index in range(10):
+            process.publish(log_topic, f"line {index}")
+        get_broker().drain()
+        wait_for(lambda: recorder.records(log_topic) and
+                 recorder.records(log_topic)[-1] == "line 9")
+        assert recorder.records(log_topic) == [
+            "line 6", "line 7", "line 8", "line 9"]
+        process.terminate()
+
+
+class TestLifeCycle:
+    def test_handshake_and_delete(self, tmp_path):
+        registrar_process = Process(transport_kind="loopback")
+        Registrar(registrar_process, search_timeout=0.05)
+        registrar_process.run(in_thread=True)
+
+        manager_process = Process(transport_kind="loopback")
+        changes = []
+        manager = LifeCycleManager(
+            manager_process, "lcm",
+            client_change_handler=lambda cmd, cid: changes.append(
+                (cmd, cid)))
+        manager_process.run(in_thread=True)
+
+        # the OS child is a dummy sleeper; the handshake comes from a
+        # client living in this test process on the shared loopback broker
+        sleeper = tmp_path / "sleeper.py"
+        sleeper.write_text("import time; time.sleep(30)\n")
+        client_id = manager.create_client(str(sleeper))
+        record = manager.clients[client_id]
+        assert record["state"] == "spawning"
+
+        client_process = Process(transport_kind="loopback")
+        client = LifeCycleClient(
+            client_process, "worker", manager.topic_path, client_id)
+        client.share["task"] = "indexing"
+        client_process.run(in_thread=True)
+
+        wait_for(lambda: manager.clients[client_id]["state"] == "running",
+                 timeout=10)
+        assert ("add", client_id) in changes
+
+        # manager mirrors the client's share via ECConsumer
+        client.ec_producer.update("task", "training")
+        wait_for(lambda: manager.clients[client_id]["share"].get(
+            "task") == "training", timeout=10)
+
+        manager.delete_client(client_id)
+        wait_for(lambda: client_id not in manager.clients, timeout=15)
+        assert ("remove", client_id) in changes
+
+        for process in (registrar_process, manager_process,
+                        client_process):
+            process.terminate()
+
+    def test_handshake_timeout_kills_client(self, tmp_path):
+        manager_process = Process(transport_kind="loopback")
+        manager = LifeCycleManager(manager_process, "lcm2",
+                                   handshake_lease_time=0.2)
+        manager_process.run(in_thread=True)
+        sleeper = tmp_path / "sleeper.py"
+        sleeper.write_text("import time; time.sleep(30)\n")
+        client_id = manager.create_client(str(sleeper))
+        wait_for(lambda: client_id not in manager.clients, timeout=10)
+        assert client_id not in manager.process_manager
+        manager_process.terminate()
