@@ -38,12 +38,15 @@ class DashboardModel:
         self._log_topic = None
 
     def _service_event(self, command, fields) -> None:
+        # copy-on-write: the curses thread iterates self.rows concurrently
+        rows = dict(self.rows)
         if command == "add":
-            self.rows[fields.topic_path] = fields
+            rows[fields.topic_path] = fields
         else:
-            self.rows.pop(fields.topic_path, None)
-            if fields.topic_path == self.selected:
-                self.select(None)
+            rows.pop(fields.topic_path, None)
+        self.rows = rows
+        if command != "add" and fields.topic_path == self.selected:
+            self.select(None)
 
     # -- selection + share mirror (reference dashboard.py:344-366) ---------
 

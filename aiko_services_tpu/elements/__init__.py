@@ -17,3 +17,4 @@ from .audio_io import (                                       # noqa: F401
     AudioReadFile, AudioWriteFile, ToneSource, AudioFraming, AudioSample)
 from .video_io import (                                       # noqa: F401
     VideoReadFile, VideoSample, VideoWriteFile, VideoOutput)
+from .webcam_io import VideoReadWebcam                        # noqa: F401

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.attention import flash_attention
+from ..parallel.attention import flash_attention, ring_attention
 from .layers import (
     apply_rotary, dense, init_dense, init_norm, repeat_kv, rms_norm,
     rotary_embedding)
@@ -48,6 +48,10 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
     dtype: str = "bfloat16"
+    # True: prefill attention runs as ring attention over the mesh "seq"
+    # axis (shard_map + ppermute; requires an ambient jax.set_mesh whose
+    # seq axis divides the sequence length) -- the long-context path.
+    sequence_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -153,8 +157,12 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
     repeats = config.n_heads // config.n_kv_heads
 
     if cache_k is None:
-        out = flash_attention(q, repeat_kv(k, repeats),
-                              repeat_kv(v, repeats), causal=True)
+        if config.sequence_parallel:
+            out = ring_attention(q, repeat_kv(k, repeats),
+                                 repeat_kv(v, repeats), causal=True)
+        else:
+            out = flash_attention(q, repeat_kv(k, repeats),
+                                  repeat_kv(v, repeats), causal=True)
     else:
         cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))

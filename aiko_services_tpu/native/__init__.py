@@ -1,0 +1,24 @@
+# Native extension loader: exposes sexpr_parse_native (or None when the
+# extension is not built).  Build with:
+#   python -m aiko_services_tpu.native.build
+
+from __future__ import annotations
+
+sexpr_parse_native = None
+
+try:
+    from . import _sexpr_native as _ext
+except ImportError:
+    _ext = None
+
+if _ext is not None:
+    def sexpr_parse_native(payload):
+        if isinstance(payload, str):
+            payload = payload.encode("latin-1")
+        return _ext.parse_bytes(payload)
+
+    def install_parse_error(exception_class) -> None:
+        _ext.set_parse_error(exception_class)
+else:  # pragma: no cover
+    def install_parse_error(exception_class) -> None:
+        pass

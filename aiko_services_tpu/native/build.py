@@ -1,0 +1,44 @@
+# Build the native extensions in place: python -m
+# aiko_services_tpu.native.build
+#
+# Direct g++ invocation against the running interpreter's headers (no
+# pybind11/setuptools needed -- the extension uses the raw CPython API).
+# Produces _sexpr_native.<abi>.so next to this file; native/__init__.py
+# picks it up on the next import and the sexpr codec switches to the
+# native fast path automatically.
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import sysconfig
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+
+
+def build(verbose: bool = True) -> Path | None:
+    source = HERE / "sexpr_codec.cpp"
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    target = HERE / f"_sexpr_native{suffix}"
+    if (target.exists()
+            and target.stat().st_mtime >= source.stat().st_mtime):
+        return target
+    include = sysconfig.get_paths()["include"]
+    command = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", str(source), "-o", str(target),
+    ]
+    result = subprocess.run(command, capture_output=True, text=True)
+    if result.returncode != 0:
+        if verbose:
+            print(f"native build failed:\n{result.stderr}",
+                  file=sys.stderr)
+        return None
+    if verbose:
+        print(f"built {target.name}")
+    return target
+
+
+if __name__ == "__main__":
+    sys.exit(0 if build() else 1)

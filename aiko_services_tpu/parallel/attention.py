@@ -295,15 +295,19 @@ def ring_attention_sharded(q, k, v, axis_name: str = "seq",
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name: str = "seq",
+def ring_attention(q, k, v, mesh=None, axis_name: str = "seq",
                    causal: bool = True, sm_scale=None):
     """shard_map entry point: shards (B, H, L, D) on the seq axis and runs
-    ring_attention_sharded over the mesh."""
+    ring_attention_sharded.  mesh=None uses the ambient mesh (callers
+    inside a jax.set_mesh context, e.g. the transformer's
+    sequence-parallel prefill)."""
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale)
+    kwargs = {} if mesh is None else {"mesh": mesh}
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        fn, in_specs=(spec, spec, spec), out_specs=spec,
+        **kwargs)(q, k, v)
 
 
 # -- Ulysses (all-to-all) sequence parallelism ------------------------------

@@ -84,6 +84,7 @@ class Pipeline(Actor):
         self._frame_count = 0
         self.elements: dict[str, object] = {}
         self._services_cache: ServicesCache | None = None
+        self._remote_handlers: list = []
         self.share.update({
             "definition_name": definition.name,
             "element_count": len(definition.elements),
@@ -139,6 +140,7 @@ class Pipeline(Actor):
                 remote.set_absent()
 
         self._services_cache.add_handler(handler, service_filter)
+        self._remote_handlers.append(handler)
 
     def _update_lifecycle(self) -> None:
         ready = all(
@@ -517,6 +519,12 @@ class Pipeline(Actor):
     def stop(self) -> None:
         for stream_id in list(self.streams):
             self.destroy_stream(stream_id)
+        if self._services_cache is not None:
+            # the cache is process-shared: detach OUR handlers so a
+            # stopped pipeline stops reacting to service churn
+            for handler in self._remote_handlers:
+                self._services_cache.remove_handler(handler)
+            self._remote_handlers.clear()
         for element in self.elements.values():
             if not isinstance(element, RemoteElement):
                 element.stop()

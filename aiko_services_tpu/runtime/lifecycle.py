@@ -85,7 +85,9 @@ class LifeCycleManager(Actor):
         if record is None:
             _LOGGER.warning("add_client for unknown id %s", client_id)
             return
-        if record["state"] == "running":  # duplicate handshake: idempotent
+        if record["state"] != "spawning":
+            # duplicate handshake (running) is idempotent; a handshake
+            # during deletion must NOT cancel the pending deletion
             return
         record["state"] = "running"
         record["topic_path"] = topic_path
@@ -136,8 +138,13 @@ class LifeCycleManager(Actor):
         self._update_share()
         if self._client_change_handler:
             self._client_change_handler("remove", client_id)
-        if kill:  # last: kill blocks up to the grace timeout
-            self.process_manager.kill(client_id)
+        if kill:
+            # kill waits up to its grace timeout; keep that off the event
+            # loop so other leases/mailboxes keep flowing
+            import threading
+            threading.Thread(
+                target=self.process_manager.kill, args=(client_id,),
+                name=f"lifecycle-kill-{client_id}", daemon=True).start()
 
     def _update_share(self) -> None:
         if self.ec_producer is not None:

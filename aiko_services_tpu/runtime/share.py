@@ -299,16 +299,14 @@ class ServicesCache:
                 handler(command, fields)
 
 
-_SERVICES_CACHE_SINGLETONS: dict = {}
-
-
 def services_cache_create_singleton(process) -> ServicesCache:
     """One shared registrar mirror per Process (reference
     share.py:639-656): repeated do_command/do_request/remote-element use
     must not accumulate one full cache (plus registrar subscriptions)
-    per call."""
-    cache = _SERVICES_CACHE_SINGLETONS.get(id(process))
-    if cache is None or cache.process is not process:
+    per call.  Stored ON the process so the cache's lifetime is exactly
+    the process's (no global registry pinning terminated processes)."""
+    cache = getattr(process, "_services_cache_singleton", None)
+    if cache is None:
         cache = ServicesCache(process)
-        _SERVICES_CACHE_SINGLETONS[id(process)] = cache
+        process._services_cache_singleton = cache
     return cache

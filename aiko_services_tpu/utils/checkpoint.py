@@ -48,29 +48,47 @@ class Checkpointer:
         return sorted(found)
 
     def save(self, step: int, pytree, metadata: dict | None = None) -> Path:
+        """Atomic: state + metadata land in a hidden staging dir that is
+        rename()d into place, so a preemption mid-save can never leave a
+        half-written newest step for restore() to pick up."""
+        import shutil
+        staging = self.directory / f".staging_step_{step}"
+        if staging.exists():
+            shutil.rmtree(staging)
+        self._checkpointer.save(staging / "state", pytree)
+        (staging / "metadata.json").write_text(
+            json.dumps(metadata or {}, sort_keys=True))
         target = self._step_dir(step)
         if target.exists():
-            import shutil
             shutil.rmtree(target)
-        self._checkpointer.save(target / "state", pytree)
-        (target / "metadata.json").write_text(
-            json.dumps(metadata or {}, sort_keys=True))
+        staging.rename(target)
         self._prune()
         _LOGGER.info("Checkpoint saved: %s", target)
         return target
 
     def restore(self, step: int | None = None):
-        """Returns (pytree, metadata); (None, {}) when nothing exists."""
+        """Returns (pytree, metadata); (None, {}) when nothing exists.
+        With step=None, falls back to older steps if the newest is
+        unreadable."""
         steps = self.steps()
         if not steps:
             return None, {}
-        step = steps[-1] if step is None else step
-        target = self._step_dir(step)
-        pytree = self._checkpointer.restore(target / "state")
-        metadata_path = target / "metadata.json"
-        metadata = (json.loads(metadata_path.read_text())
-                    if metadata_path.exists() else {})
-        return pytree, metadata
+        candidates = [step] if step is not None else list(reversed(steps))
+        last_error = None
+        for candidate in candidates:
+            target = self._step_dir(candidate)
+            try:
+                pytree = self._checkpointer.restore(target / "state")
+                metadata = json.loads(
+                    (target / "metadata.json").read_text())
+            except Exception as error:  # corrupt step: try the previous
+                last_error = error
+                _LOGGER.warning("Checkpoint %s unreadable: %s",
+                                target, error)
+                continue
+            return pytree, metadata
+        raise RuntimeError(
+            f"No readable checkpoint in {self.directory}") from last_error
 
     def latest_step(self) -> int | None:
         steps = self.steps()

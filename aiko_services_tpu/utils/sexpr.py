@@ -28,6 +28,13 @@ class ParseError(ValueError):
 _QUOTE_NEEDED = set(' \t\r\n()"')
 
 
+def _ascii_digits(text: str) -> bool:
+    """ASCII-only digit check: str.isdigit() accepts unicode digits like
+    superscripts that int() rejects, which would make the tokenizer raise
+    bare ValueError (and diverge from the native parser)."""
+    return bool(text) and all("0" <= ch <= "9" for ch in text)
+
+
 def _atom_needs_quoting(text: str) -> bool:
     if text == "":
         return True
@@ -36,7 +43,7 @@ def _atom_needs_quoting(text: str) -> bool:
     # "12:34" would parse as a canonical "len:data" symbol -- quote it so
     # generate() and parse() stay inverses
     colon = text.find(":")
-    return colon > 0 and text[:colon].isdigit()
+    return colon > 0 and _ascii_digits(text[:colon])
 
 
 def _generate_value(value) -> str:
@@ -113,9 +120,9 @@ class _Tokenizer:
             pos += 1
             if ch == ":" and pos > start + 1:
                 # Possible canonical symbol "len:data": the run before the
-                # colon must be all digits.
+                # colon must be all ASCII digits.
                 digits = text[start:pos - 1]
-                if digits.isdigit():
+                if _ascii_digits(digits):
                     size = int(digits)
                     end = pos + size
                     if end > length:
@@ -161,13 +168,7 @@ def _parse_expression(tok: _Tokenizer):
     return tok.read_atom()
 
 
-def parse(payload) -> tuple:
-    """Parse one S-expression payload into (command, parameters).
-
-    Accepts str or bytes (bytes are latin-1 decoded so canonical symbols are
-    binary-safe).  A bare atom parses as (atom, []).  Returns ("", []) for an
-    empty payload.
-    """
+def _parse_python(payload) -> tuple:
     if isinstance(payload, bytes):
         payload = payload.decode("latin-1")
     tok = _Tokenizer(payload)
@@ -188,6 +189,35 @@ def parse(payload) -> tuple:
     if not isinstance(command, str):
         return "", expression
     return command, expression[1:]
+
+
+# Native fast path: the C++ extension (native/sexpr_codec.cpp) parses
+# byte-per-char identically; built via `python -m
+# aiko_services_tpu.native.build`.  Payloads outside latin-1 (exotic
+# unicode atoms) take the Python path.
+try:
+    from ..native import sexpr_parse_native as _parse_native
+    from ..native import install_parse_error as _install_parse_error
+except ImportError:  # pragma: no cover
+    _parse_native = None
+else:
+    if _parse_native is not None:
+        _install_parse_error(ParseError)
+
+
+def parse(payload) -> tuple:
+    """Parse one S-expression payload into (command, parameters).
+
+    Accepts str or bytes (bytes are latin-1 decoded so canonical symbols are
+    binary-safe).  A bare atom parses as (atom, []).  Returns ("", []) for an
+    empty payload.
+    """
+    if _parse_native is not None:
+        try:
+            return _parse_native(payload)
+        except UnicodeEncodeError:
+            pass  # non-latin-1 text: python path handles full unicode
+    return _parse_python(payload)
 
 
 def parse_list_to_dict(items) -> dict:

@@ -1,0 +1,111 @@
+# Multitude load test: chained remote pipelines, the formalized version of
+# the reference's shell-script load test (reference: src/aiko_services/
+# examples/pipeline/multitude/run_small.sh -- 3 chained remote PE_Add
+# pipelines driven by mosquitto_pub, observed ceiling ~50 frames/sec;
+# run_large.sh scales to 10).
+#
+#   python examples/multitude.py --pipelines 3 --frames 200
+#
+# Builds N pipelines where pipeline i's "add" element is REMOTE, served by
+# pipeline i+1 (the last one is fully local), drives frames through the
+# chain, and reports sustained frames/sec -- directly comparable to the
+# reference's 50 Hz number, on the loopback broker (or MQTT via
+# AIKO_MQTT_HOST).
+
+from __future__ import annotations
+
+import argparse
+import queue
+import time
+
+
+def chained_definition(index: int, count: int) -> dict:
+    """Each pipeline adds 1 locally, then (except the last) forwards the
+    frame to the next pipeline in the chain as a remote element -- the
+    reference multitude topology (run_small.sh:53-61)."""
+    elements = [
+        {"name": "add",
+         "input": [{"name": "number"}],
+         "output": [{"name": "number"}],
+         "parameters": {"constant": 1},
+         "deploy": {"local": {"module": "aiko_services_tpu.elements",
+                              "class_name": "PE_Add"}}},
+    ]
+    if index == count - 1:
+        graph = ["(add)"]
+    else:
+        graph = ["(add (next))"]
+        elements.append(
+            {"name": "next",
+             "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "deploy": {"remote": {"service_filter": {
+                 "name": f"multitude_{index + 1}"}}}})
+    return {"name": f"multitude_{index}", "graph": graph,
+            "elements": elements}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pipelines", type=int, default=3)
+    parser.add_argument("--frames", type=int, default=200)
+    parser.add_argument("--transport", default="loopback")
+    arguments = parser.parse_args()
+
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process, Registrar
+
+    registrar_process = Process(transport_kind=arguments.transport)
+    Registrar(registrar_process, search_timeout=0.1)
+    registrar_process.run(in_thread=True)
+
+    processes, pipelines = [], []
+    for index in reversed(range(arguments.pipelines)):
+        process = Process(transport_kind=arguments.transport)
+        pipelines.insert(0, create_pipeline(
+            process, chained_definition(index, arguments.pipelines)))
+        process.run(in_thread=True)
+        processes.append(process)
+
+    head = pipelines[0]
+    deadline = time.time() + 30
+    while time.time() < deadline and not head.ready:
+        time.sleep(0.05)
+    if not head.ready:
+        raise SystemExit("chain never became ready")
+
+    responses = queue.Queue()
+    head.create_stream("load", queue_response=responses, grace_time=300)
+    # warmup
+    for index in range(10):
+        head.process_frame({"stream_id": "load"}, {"number": index})
+    for _ in range(10):
+        responses.get(timeout=30)
+
+    start = time.perf_counter()
+    in_flight = 0
+    completed = 0
+    sent = 0
+    while completed < arguments.frames:
+        while in_flight < 32 and sent < arguments.frames:
+            head.process_frame({"stream_id": "load"}, {"number": sent})
+            sent += 1
+            in_flight += 1
+        _, _, outputs = responses.get(timeout=30)
+        # each of the N chained pipelines added 1
+        assert int(outputs["number"]) >= arguments.pipelines
+        completed += 1
+        in_flight -= 1
+    elapsed = time.perf_counter() - start
+
+    rate = arguments.frames / elapsed
+    print(f"multitude: {arguments.pipelines} chained pipelines, "
+          f"{arguments.frames} frames, {rate:.1f} frames/sec "
+          f"(reference ceiling: ~50 frames/sec, run_small.sh:9)")
+
+    for process in processes + [registrar_process]:
+        process.terminate()
+
+
+if __name__ == "__main__":
+    main()

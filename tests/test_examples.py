@@ -1,0 +1,55 @@
+# Example pipeline definitions must parse and (the cheap ones) run.
+
+import queue
+from pathlib import Path
+
+import pytest
+
+from aiko_services_tpu.pipeline import (
+    create_pipeline, parse_pipeline_definition)
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.transport import reset_brokers
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+@pytest.mark.parametrize("path", sorted(EXAMPLES.glob("*.json")),
+                         ids=lambda p: p.name)
+def test_example_definitions_parse(path):
+    definition = parse_pipeline_definition(path)
+    assert definition.elements
+
+
+def test_pipeline_text_example_runs():
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process,
+                               str(EXAMPLES / "pipeline_text.json"))
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s", queue_response=responses)
+    # 4 inputs, sample_rate 2 -> 2 surviving frames, uppercased
+    texts = sorted(responses.get(timeout=15)[2]["text"] for _ in range(2))
+    assert texts == ["FRAME THREE", "HELLO WORLD"]
+    process.terminate()
+
+
+def test_pipeline_compute_example_runs():
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process,
+                               str(EXAMPLES / "pipeline_compute.json"))
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s", queue_response=responses)
+    import numpy as np
+    for _ in range(3):
+        _, _, outputs = responses.get(timeout=30)
+        assert outputs["tensor"].shape == (8, 16)
+        assert np.isfinite(outputs["tensor"]).all()
+    process.terminate()

@@ -1,0 +1,93 @@
+# Native S-expression parser: build the C++ extension, then run the SAME
+# corpus through the native and pure-Python parsers and require identical
+# results (including error behavior).
+
+import importlib
+
+import pytest
+
+from aiko_services_tpu.native.build import build
+from aiko_services_tpu.utils import sexpr
+
+CORPUS = [
+    "",
+    "(test)",
+    "(add topic name protocol transport owner (a=b c=d))",
+    "(process_frame (stream_id: 1 frame_id: 0) (a: 0))",
+    '(say "hello world" "quo\\"ted")',
+    "(share response/topic 300 *)",
+    "(nested (a (b (c))) ())",
+    "(mixed (a: 1) plain (b: 2))",
+    "atom_only",
+    "(numbers 1 2.5 -3 1e-6)",
+    "(canon 5:ab cd x)",
+    "(canon 3:\x00\x01\xff end)",
+    "  ( spaced   out )  ",
+    "(empty ())",
+    "(keyword_odd a: 1 b:)",
+]
+
+MALFORMED = ["((((", "(unterminated", '("unclosed)', "(a) trailing",
+             "(overrun 99:x)"]
+
+
+@pytest.fixture(scope="module")
+def native_parse():
+    target = build(verbose=False)
+    if target is None:
+        pytest.skip("native toolchain unavailable")
+    import aiko_services_tpu.native as native_package
+    importlib.reload(native_package)
+    if native_package.sexpr_parse_native is None:
+        pytest.skip("extension failed to load")
+    native_package.install_parse_error(sexpr.ParseError)
+    return native_package.sexpr_parse_native
+
+
+def test_native_matches_python_on_corpus(native_parse):
+    for payload in CORPUS:
+        expected = sexpr._parse_python(payload)
+        actual = native_parse(payload)
+        assert actual == expected, f"mismatch on {payload!r}"
+
+
+def test_native_roundtrip_generate(native_parse):
+    payload = sexpr.generate(
+        "process_frame",
+        [{"stream_id": "7", "frame_id": "3"}, {"x": "1", "y": "2"}])
+    assert native_parse(payload) == sexpr._parse_python(payload)
+
+
+def test_native_malformed_raises_parse_error(native_parse):
+    for payload in MALFORMED:
+        with pytest.raises(sexpr.ParseError):
+            native_parse(payload)
+        with pytest.raises(sexpr.ParseError):
+            sexpr._parse_python(payload)
+
+
+def test_native_binary_canonical_symbols(native_parse):
+    blob = bytes(range(256)).decode("latin-1")
+    payload = f"(blob {len(blob)}:{blob})"
+    command, parameters = native_parse(payload)
+    assert command == "blob"
+    assert parameters[0] == blob
+
+
+def test_native_faster_than_python(native_parse):
+    import time
+    payload = sexpr.generate(
+        "add", ["namespace/host/1234/5", "pipeline_worker",
+                "github.com/x/protocol/pipeline:0", "mqtt", "owner",
+                ["ec=true", "stage=3"]])
+    iterations = 3000
+    start = time.perf_counter()
+    for _ in range(iterations):
+        native_parse(payload)
+    native_seconds = time.perf_counter() - start
+    start = time.perf_counter()
+    for _ in range(iterations):
+        sexpr._parse_python(payload)
+    python_seconds = time.perf_counter() - start
+    # regression guard only: native must not be slower
+    assert native_seconds < python_seconds
