@@ -17,9 +17,35 @@ from .runtime.service import ServiceFilter
 from .runtime.share import ServicesCache
 from .utils import generate, get_logger
 
-__all__ = ["DashboardModel", "run_dashboard", "render_snapshot"]
+__all__ = ["DashboardModel", "run_dashboard", "render_snapshot",
+           "register_plugin", "plugin_for"]
 
 _LOGGER = get_logger("dashboard")
+
+# Per-protocol detail renderers (reference dashboard _PLUGINS,
+# dashboard.py:726-730): plugin(model) -> list[str] extra detail lines
+# for the selected service.
+_PLUGINS: dict = {}
+
+
+def register_plugin(protocol_name: str, renderer) -> None:
+    _PLUGINS[protocol_name] = renderer
+
+
+def plugin_for(protocol: str):
+    from .runtime.service import ServiceProtocol
+    name, _ = ServiceProtocol.name_version(str(protocol))
+    return _PLUGINS.get(name)
+
+
+def _registrar_plugin(model: "DashboardModel") -> list:
+    share = model.selected_share
+    return [f"registrar state: {share.get('state', '?')}   "
+            f"services: {share.get('service_count', '?')}   "
+            f"started: {share.get('time_started', '?')}"]
+
+
+register_plugin("registrar", _registrar_plugin)
 
 
 class DashboardModel:
@@ -131,15 +157,21 @@ def _run_curses(model: DashboardModel) -> None:  # pragma: no cover
                         f"{str(fields.protocol).rsplit('/', 1)[-1]:20.20}")
                 screen.addstr(row + 2, 0, line)
             if rows and index < len(rows):
-                selected_topic = rows[index][0]
+                selected_topic, selected_fields = rows[index]
                 if model.selected != selected_topic:
                     model.select(selected_topic)
                 base = min(len(rows), 30) + 3
                 screen.addstr(base, 0, "share:", curses.A_BOLD)
+                offset = 0
                 for offset, (key, value) in enumerate(
                         sorted(model.selected_share.items())[:15]):
                     screen.addstr(base + 1 + offset, 2,
                                   f"{key} = {value}"[:100])
+                plugin = plugin_for(selected_fields.protocol)
+                if plugin is not None:
+                    for extra, line in enumerate(plugin(model)):
+                        screen.addstr(base + offset + 2 + extra, 2,
+                                      str(line)[:100], curses.A_DIM)
             screen.refresh()
             key = screen.getch()
             if key == ord("q"):

@@ -18,3 +18,5 @@ from .audio_io import (                                       # noqa: F401
 from .video_io import (                                       # noqa: F401
     VideoReadFile, VideoSample, VideoWriteFile, VideoOutput)
 from .webcam_io import VideoReadWebcam                        # noqa: F401
+from .gstreamer_io import (                                   # noqa: F401
+    VideoStreamReader, VideoStreamWriter, gst_available)

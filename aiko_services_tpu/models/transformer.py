@@ -52,6 +52,10 @@ class TransformerConfig:
     # axis (shard_map + ppermute; requires an ambient jax.set_mesh whose
     # seq axis divides the sequence length) -- the long-context path.
     sequence_parallel: bool = False
+    # > 0: the FFN becomes a switch (top-1) mixture of experts with this
+    # many experts; expert weights shard over the mesh "expert" axis
+    # (param_specs), giving expert parallelism.  0 = dense FFN.
+    n_experts: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -65,20 +69,34 @@ class TransformerConfig:
 # -- parameters -------------------------------------------------------------
 
 def _init_layer(key, config: TransformerConfig) -> dict:
-    keys = jax.random.split(key, 7)
-    d, hd = config.d_model, config.head_dim
+    keys = jax.random.split(key, 8)
+    d, hd, ff = config.d_model, config.head_dim, config.d_ff
     dtype = config.jnp_dtype
-    return {
+    layer = {
         "attn_norm": init_norm(d, dtype),
         "wq": init_dense(keys[0], d, config.n_heads * hd, dtype),
         "wk": init_dense(keys[1], d, config.n_kv_heads * hd, dtype),
         "wv": init_dense(keys[2], d, config.n_kv_heads * hd, dtype),
         "wo": init_dense(keys[3], config.n_heads * hd, d, dtype),
         "mlp_norm": init_norm(d, dtype),
-        "w_gate": init_dense(keys[4], d, config.d_ff, dtype),
-        "w_up": init_dense(keys[5], d, config.d_ff, dtype),
-        "w_down": init_dense(keys[6], config.d_ff, d, dtype),
     }
+    if config.n_experts > 0:
+        experts = config.n_experts
+
+        def expert_weights(key, rows, cols):
+            return {"w": (jax.random.normal(
+                key, (experts, rows, cols), jnp.float32)
+                / jnp.sqrt(jnp.float32(rows))).astype(dtype)}
+
+        layer["router"] = init_dense(keys[7], d, experts, dtype)
+        layer["w_gate"] = expert_weights(keys[4], d, ff)
+        layer["w_up"] = expert_weights(keys[5], d, ff)
+        layer["w_down"] = expert_weights(keys[6], ff, d)
+    else:
+        layer["w_gate"] = init_dense(keys[4], d, ff, dtype)
+        layer["w_up"] = init_dense(keys[5], d, ff, dtype)
+        layer["w_down"] = init_dense(keys[6], ff, d, dtype)
+    return layer
 
 
 def init_params(config: TransformerConfig, key) -> dict:
@@ -96,9 +114,9 @@ def init_params(config: TransformerConfig, key) -> dict:
 
 
 def param_specs(config: TransformerConfig) -> dict:
-    """Megatron TP on 'model' + FSDP on 'fsdp'; stacked-layer leaves carry
-    a leading None for the scan axis.  (Scaling-book recipe: shard the big
-    matmuls, replicate the small norms.)"""
+    """Megatron TP on 'model' + FSDP on 'fsdp' (+ EP on 'expert' for MoE
+    weights); stacked-layer leaves carry a leading None for the scan axis.
+    (Scaling-book recipe: shard the big matmuls, replicate the norms.)"""
     layer = {
         "attn_norm": {"scale": P(None, None)},
         "wq": {"w": P(None, "fsdp", "model")},
@@ -106,10 +124,16 @@ def param_specs(config: TransformerConfig) -> dict:
         "wv": {"w": P(None, "fsdp", "model")},
         "wo": {"w": P(None, "model", "fsdp")},
         "mlp_norm": {"scale": P(None, None)},
-        "w_gate": {"w": P(None, "fsdp", "model")},
-        "w_up": {"w": P(None, "fsdp", "model")},
-        "w_down": {"w": P(None, "model", "fsdp")},
     }
+    if config.n_experts > 0:
+        layer["router"] = {"w": P(None, None, None)}
+        layer["w_gate"] = {"w": P(None, "expert", "fsdp", "model")}
+        layer["w_up"] = {"w": P(None, "expert", "fsdp", "model")}
+        layer["w_down"] = {"w": P(None, "expert", "model", "fsdp")}
+    else:
+        layer["w_gate"] = {"w": P(None, "fsdp", "model")}
+        layer["w_up"] = {"w": P(None, "fsdp", "model")}
+        layer["w_down"] = {"w": P(None, "model", "fsdp")}
     return {
         "embed": {"w": P(None, "fsdp")},
         "layers": layer,
@@ -182,6 +206,36 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
     return dense(layer["wo"], out), cache_k, cache_v
 
 
+def _switch_moe(config: TransformerConfig, layer, x):
+    """Switch (top-1) mixture-of-experts FFN.
+
+    Masked-dense dispatch: every expert computes over all tokens and a
+    one-hot router mask selects the winner.  With expert weights sharded
+    on the "expert" mesh axis, XLA partitions the expert dimension so
+    each device runs only its local experts (true EP); compute per device
+    is E_local x the dense FFN.  A capacity-based gather dispatch (no
+    masked waste) is the production follow-up for large expert counts.
+    """
+    router_logits = jnp.einsum(
+        "bld,de->ble", x.astype(jnp.float32),
+        layer["router"]["w"].astype(jnp.float32))
+    router_probs = jax.nn.softmax(router_logits, axis=-1)
+    best = jnp.argmax(router_probs, axis=-1)               # (B, L)
+    mask = jax.nn.one_hot(best, config.n_experts,
+                          dtype=jnp.float32)               # (B, L, E)
+    weight = jnp.sum(router_probs * mask, axis=-1,
+                     keepdims=True)                        # (B, L, 1)
+    gate = jnp.einsum("bld,edf->blef", x, layer["w_gate"]["w"],
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("bld,edf->blef", x, layer["w_up"]["w"],
+                    preferred_element_type=jnp.float32)
+    hidden = jax.nn.silu(gate) * up                        # (B, L, E, F)
+    expert_out = jnp.einsum("blef,efd->bled", hidden,
+                            layer["w_down"]["w"].astype(jnp.float32))
+    mixed = jnp.sum(expert_out * mask[..., None], axis=2)  # (B, L, D)
+    return (mixed * weight).astype(x.dtype)
+
+
 def forward(params: dict, config: TransformerConfig, tokens,
             cache: dict | None = None, pos: int = 0,
             activation_specs: bool = False):
@@ -209,10 +263,13 @@ def forward(params: dict, config: TransformerConfig, tokens,
             pos=pos)
         h = h + attn_out
         mlp_in = rms_norm(layer["mlp_norm"], h, config.norm_eps)
-        mlp_out = dense(
-            layer["w_down"],
-            jax.nn.silu(dense(layer["w_gate"], mlp_in))
-            * dense(layer["w_up"], mlp_in))
+        if config.n_experts > 0:
+            mlp_out = _switch_moe(config, layer, mlp_in)
+        else:
+            mlp_out = dense(
+                layer["w_down"],
+                jax.nn.silu(dense(layer["w_gate"], mlp_in))
+                * dense(layer["w_up"], mlp_in))
         h = h + mlp_out
         if activation_specs:
             h = jax.lax.with_sharding_constraint(h, P("data", "seq", None))

@@ -65,7 +65,18 @@ class ComputeElement(PipelineElement):
     def __init__(self, process, pipeline, definition):
         super().__init__(process, pipeline, definition)
         sharding = dict(definition.sharding or {})
-        self.mesh = get_mesh(sharding.get("axes")) if sharding else None
+        if sharding:
+            # "devices": [start, end) pins this element to a mesh
+            # SUB-SLICE -- pipeline stages partition the pod (stage-level
+            # pipeline parallelism, SURVEY.md 2.4 PP equivalent)
+            devices = None
+            device_range = sharding.get("devices")
+            if device_range:
+                start, end = int(device_range[0]), int(device_range[1])
+                devices = jax.devices()[start:end]
+            self.mesh = get_mesh(sharding.get("axes"), devices)
+        else:
+            self.mesh = None
         self._state_spec = sharding.get("state")
         self._input_specs = dict(sharding.get("inputs", {}))
         self._bucket_axes = dict(
@@ -209,7 +220,11 @@ class ComputeElement(PipelineElement):
                 for name, axis in self._bucket_axes.items()
                 if name in inputs}
         try:
-            outputs = self._compiled(self.state, dynamic, placed)
+            # TraceAnnotation: per-element spans in jax.profiler traces
+            # (SURVEY.md section 5 tracing parity)
+            with jax.profiler.TraceAnnotation(
+                    f"element:{self.definition.name}"):
+                outputs = self._compiled(self.state, dynamic, placed)
         except TypeError as error:
             bad = {name: type(value).__name__
                    for name, value in placed.items()

@@ -11,3 +11,4 @@ from .timeutil import (                                     # noqa: F401
 from .logger import get_logger, RingBufferHandler           # noqa: F401
 from .importer import load_module                           # noqa: F401
 from .padding import bucket_length, pad_axis_to             # noqa: F401,E402
+from .network import get_network_ports_listen               # noqa: F401,E402

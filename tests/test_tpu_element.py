@@ -178,6 +178,45 @@ def test_sharded_state_on_mesh():
     assert outputs["tensor"].shape == (8, 16)
 
 
+def test_mesh_subslice_stage_placement():
+    """sharding.devices pins an element to a device sub-range: two stages
+    split the 8-device host into disjoint 4-device meshes (stage-level
+    pipeline parallelism)."""
+    definition = _compute_pipeline(
+        {"axes": {"data": -1}, "devices": [0, 4],
+         "inputs": {"tensor": ["data", None]}})
+    definition["elements"][0]["parameters"]["data_sources"] = [[8, 16]]
+    pipeline, _, outputs = _run_one_frame(definition)
+    element = pipeline.elements["mlp"]
+    assert element.mesh.devices.size == 4
+    import jax
+    assert set(element.mesh.devices.flat) == set(jax.devices()[:4])
+    assert outputs["tensor"].shape == (8, 16)
+
+
+def test_gstreamer_elements_gated():
+    """Without GStreamer the stream elements fail the stream with a clear
+    diagnostic instead of crashing the pipeline."""
+    from aiko_services_tpu.elements import gst_available
+    if gst_available():  # pragma: no cover
+        pytest.skip("GStreamer present; gating not exercised")
+    definition = {
+        "name": "gst_pipe",
+        "graph": ["(reader)"],
+        "elements": [
+            {"name": "reader", "output": [{"name": "image"}],
+             "parameters": {"data_sources": ["rtsp://nowhere/stream"]},
+             "deploy": local("VideoStreamReader")},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    stream = pipeline.create_stream("s1")
+    assert stream is None  # start_stream errored, stream destroyed
+    process.terminate()
+
+
 def test_scale_element_math():
     _, _, outputs = _run_one_frame({
         "name": "just_scale",
