@@ -80,7 +80,7 @@ class LMGenerate(ComputeElement):
         self._ensure_ready()
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
         tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
-        out = generate(self.state, self.config, tokens, max_new)
+        out, _ = generate(self.state, self.config, tokens, max_new)
         return StreamEvent.OKAY, {"generated": out}
 
     def compute(self, state, **inputs):  # pragma: no cover
@@ -138,7 +138,7 @@ class TokensToText(PipelineElement):
         texts = []
         for row in token_array:
             data = bytes(int(t) - _BYTE_OFFSET for t in row
-                         if t >= _BYTE_OFFSET)
+                         if _BYTE_OFFSET <= t < _BYTE_OFFSET + 256)
             texts.append(data.decode("utf-8", errors="replace"))
         return StreamEvent.OKAY, {"text": texts}
 

@@ -327,13 +327,15 @@ def _generate_compiled(params, config: TransformerConfig, prompt, cache,
         return out, cache
 
     out, cache = jax.lax.fori_loop(1, max_new_tokens, body, (out, cache))
-    return out
+    return out, cache
 
 
 def generate(params, config: TransformerConfig, prompt,
              max_new_tokens: int, cache=None):
     """Greedy generation: prefill the prompt, then fori_loop decode inside
-    one jit.  Returns (B, max_new_tokens) int32."""
+    one jit.  Returns (tokens (B, max_new_tokens) int32, cache).  A
+    caller-supplied cache (e.g. mesh-sharded) is DONATED to the jit; use
+    the returned cache, never the invalidated input buffers."""
     batch, prompt_len = prompt.shape
     if cache is None:
         cache = init_cache(config, batch,

@@ -486,9 +486,22 @@ class Pipeline(Actor):
             for name, element in self.elements.items()
             if isinstance(element, ComputeElement)
             and element.state is not None}
+        def json_safe(parameters):
+            # metadata is a JSON sidecar: keep only values that survive
+            # json round-trip (device arrays / bytes are dropped, not
+            # stringified -- a missing parameter beats a corrupt one)
+            safe = {}
+            for name, value in (parameters or {}).items():
+                try:
+                    json.dumps(value)
+                except (TypeError, ValueError):
+                    continue
+                safe[name] = value
+            return safe
+
         cursors = {
             stream_id: {"frame_id": stream.frame_id,
-                        "parameters": stream.parameters}
+                        "parameters": json_safe(stream.parameters)}
             for stream_id, stream in self.streams.items()}
         return checkpointer.save(
             step, states,

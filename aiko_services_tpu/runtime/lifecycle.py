@@ -139,12 +139,9 @@ class LifeCycleManager(Actor):
         if self._client_change_handler:
             self._client_change_handler("remove", client_id)
         if kill:
-            # kill waits up to its grace timeout; keep that off the event
-            # loop so other leases/mailboxes keep flowing
-            import threading
-            threading.Thread(
-                target=self.process_manager.kill, args=(client_id,),
-                name=f"lifecycle-kill-{client_id}", daemon=True).start()
+            # synchronous record removal + SIGTERM; the grace wait and
+            # SIGKILL escalation run off-thread inside ProcessManager.kill
+            self.process_manager.kill(client_id)
 
     def _update_share(self) -> None:
         if self.ec_producer is not None:

@@ -24,6 +24,7 @@ class ProcessManager:
     def __init__(self, process_exit_handler=None):
         self.process_exit_handler = process_exit_handler
         self.processes: dict = {}   # id -> {"process": Popen, "command":..}
+        self._escalating: list = []  # children awaiting SIGKILL escalation
         self._lock = threading.Lock()
         self._monitor: threading.Thread | None = None
         self._terminated = False
@@ -59,17 +60,38 @@ class ProcessManager:
         return child
 
     def kill(self, process_id, timeout: float = 5.0) -> None:
+        """Synchronously pop the record and send SIGTERM, so membership
+        reflects the kill the moment this returns; the grace wait and
+        SIGKILL escalation happen off-thread so callers (e.g. the event
+        loop) never block on a stubborn child.  The pop and the
+        _escalating registration share one lock acquisition so a
+        concurrent terminate() can never miss the child."""
         with self._lock:
             record = self.processes.pop(process_id, None)
-        if record is None:
-            return
-        child = record["process"]
+            if record is None:
+                return
+            child = record["process"]
+            self._escalating.append(child)
         child.terminate()
+        if self._terminated:
+            # shutdown path: a daemon escalation thread would die with
+            # the interpreter, so escalate inline (blocking is fine here)
+            self._reap(child, timeout)
+            return
+        threading.Thread(target=self._reap, args=(child, timeout),
+                         name=f"process-manager-kill-{process_id}",
+                         daemon=True).start()
+
+    def _reap(self, child, timeout: float) -> None:
         try:
             child.wait(timeout)
         except subprocess.TimeoutExpired:
             child.kill()
             child.wait()
+        finally:
+            with self._lock:
+                if child in self._escalating:
+                    self._escalating.remove(child)
 
     def kill_all(self) -> None:
         for process_id in list(self.processes):
@@ -98,6 +120,21 @@ class ProcessManager:
                         _LOGGER.exception("process_exit_handler failed")
             time.sleep(_POLL_INTERVAL)
 
-    def terminate(self) -> None:
+    def terminate(self, grace: float = 5.0) -> None:
+        """Shutdown path must not rely on daemon escalation threads (they
+        die with the interpreter): give every already-SIGTERMed child a
+        bounded shared grace to exit cleanly, then SIGKILL stragglers so
+        no SIGTERM-ignoring child survives as an orphan."""
+        import time
         self._terminated = True
         self.kill_all()
+        deadline = time.monotonic() + grace
+        with self._lock:
+            stragglers = list(self._escalating)
+            self._escalating.clear()
+        for child in stragglers:
+            try:
+                child.wait(max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait()

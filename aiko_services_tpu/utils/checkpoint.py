@@ -52,12 +52,22 @@ class Checkpointer:
         rename()d into place, so a preemption mid-save can never leave a
         half-written newest step for restore() to pick up."""
         import shutil
+        import jax
+        # encode metadata BEFORE the heavy state save so a non-JSON value
+        # (numpy array, bytes) fails fast instead of aborting after orbax
+        # has already written
+        metadata_text = json.dumps(metadata or {}, sort_keys=True)
         staging = self.directory / f".staging_step_{step}"
         if staging.exists():
             shutil.rmtree(staging)
-        self._checkpointer.save(staging / "state", pytree)
-        (staging / "metadata.json").write_text(
-            json.dumps(metadata or {}, sort_keys=True))
+        if len(jax.tree_util.tree_leaves(pytree)) > 0:
+            self._checkpointer.save(staging / "state", pytree)
+        else:
+            # orbax rejects empty pytrees ("Found empty item"); a
+            # metadata-only checkpoint (e.g. stream cursors with no
+            # ComputeElement state) is still valid
+            staging.mkdir(parents=True, exist_ok=True)
+        (staging / "metadata.json").write_text(metadata_text)
         target = self._step_dir(step)
         if target.exists():
             shutil.rmtree(target)
@@ -78,7 +88,10 @@ class Checkpointer:
         for candidate in candidates:
             target = self._step_dir(candidate)
             try:
-                pytree = self._checkpointer.restore(target / "state")
+                if (target / "state").exists():
+                    pytree = self._checkpointer.restore(target / "state")
+                else:
+                    pytree = None  # metadata-only checkpoint
                 metadata = json.loads(
                     (target / "metadata.json").read_text())
             except Exception as error:  # corrupt step: try the previous

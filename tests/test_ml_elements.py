@@ -184,3 +184,12 @@ def test_audio_wav_roundtrip(tmp_path):
     audio = np.asarray(outputs["audio"])
     assert audio.shape == (1600,)
     assert 0.5 < np.abs(audio).max() <= 1.0
+
+
+def test_tokens_to_text_out_of_range_ids():
+    # ADVICE round 1: ids >= 259 must be skipped, not crash bytes()
+    from aiko_services_tpu.elements.ml import TokensToText
+    element = TokensToText.__new__(TokensToText)
+    tokens = np.array([[0, 1, 2, 3 + ord("h"), 3 + ord("i"), 300, 1023]])
+    _, outputs = element.process_frame(None, tokens)
+    assert outputs["text"] == ["hi"]

@@ -62,8 +62,8 @@ def test_prefill_and_cached_decode_agree():
 def test_generate_greedy_deterministic():
     params = _params()
     prompt = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
-    out1 = generate(params, CONFIG, prompt, max_new_tokens=8)
-    out2 = generate(params, CONFIG, prompt, max_new_tokens=8)
+    out1, _ = generate(params, CONFIG, prompt, max_new_tokens=8)
+    out2, _ = generate(params, CONFIG, prompt, max_new_tokens=8)
     assert out1.shape == (1, 8)
     np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
     assert int(out1.min()) >= 0 and int(out1.max()) < 256
@@ -206,6 +206,7 @@ def test_sharded_decode_on_mesh():
         cache = shard_pytree(init_cache(config, batch=2, max_len=16),
                              mesh, cache_specs())
         prompt = jnp.ones((2, 4), jnp.int32)
-        out = generate(params, config, prompt, max_new_tokens=4,
-                       cache=cache)
+        out, cache = generate(params, config, prompt, max_new_tokens=4,
+                              cache=cache)
         assert out.shape == (2, 4)
+        assert cache is not None
