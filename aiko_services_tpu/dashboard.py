@@ -118,6 +118,10 @@ def render_snapshot(model: DashboardModel) -> str:
         lines.append(f"{topic_path:40} {str(fields.name):20} "
                      f"{protocol:30} {','.join(fields.tags or [])}")
     lines.append(f"-- {len(model.rows)} service(s)")
+    if model.selected is not None:
+        lines.append(f"-- log {model.selected} "
+                     f"({len(model.log_lines)} record(s))")
+        lines.extend(f"  {line}" for line in model.log_lines[-10:])
     return "\n".join(lines)
 
 

@@ -91,7 +91,6 @@ class Pipeline(Actor):
             "stream_count": 0,
             "frame_count": 0,
         })
-        ECProducer(self)
         self._produced_keys = self._compute_produced_keys()
         self._create_elements()
         self._update_lifecycle()

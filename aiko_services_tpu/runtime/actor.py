@@ -63,13 +63,16 @@ class Actor(Service):
                  tags=None, owner: str = ""):
         super().__init__(process, name, protocol=protocol, tags=tags,
                          owner=owner)
+        import logging
         self.share: dict = {
             "lifecycle": "ready",
             "name": name,
             "protocol": self.protocol,
             "tags": self.tags,
+            "log_level": logging.getLevelName(
+                self.logger.getEffectiveLevel()),
         }
-        self.ec_producer = None  # attached by ECProducer
+        self.ec_producer = None
         # wire-command -> method-name aliases (lets a command like "share"
         # coexist with the share dict attribute)
         self.command_aliases: dict[str, str] = {}
@@ -84,6 +87,9 @@ class Actor(Service):
         self.add_message_handler(self._topic_in_handler, self.topic_in)
         self.add_message_handler(self._topic_control_handler,
                                  self.topic_control)
+        # every actor shares its state over EC (reference actor.py:199-205)
+        from .share import ECProducer
+        ECProducer(self)
 
     # -- inbound message routing ------------------------------------------
 
@@ -125,6 +131,17 @@ class Actor(Service):
 
     def _mailbox_handler(self, mailbox_name: str, message) -> None:
         message.invoke()
+
+    def _ec_change_hook(self, command: str, name: str, value) -> None:
+        """Live log_level updates via the share dict, e.g. dashboard
+        publishing "(update log_level DEBUG)" to /control (reference
+        actor.py:259-265)."""
+        if command == "update" and name == "log_level":
+            try:
+                self.logger.setLevel(str(value).upper())
+            except ValueError:
+                _LOGGER.warning("%s: bad log_level ignored: %r",
+                                self.name, value)
 
     # -- local API ---------------------------------------------------------
 

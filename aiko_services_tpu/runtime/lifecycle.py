@@ -166,8 +166,7 @@ class LifeCycleClient(Actor):
         self.manager_topic_path = manager_topic_path
         self.client_id = int(client_id)
         self._announced = False
-        from .share import ECProducer
-        ECProducer(self)  # manager watches our share via ECConsumer
+        # Actor auto-creates the ECProducer the manager watches
         # add_handler replays the current state immediately, so an
         # already-REGISTRAR connection announces exactly once through it
         process.connection.add_handler(self._connection_handler)

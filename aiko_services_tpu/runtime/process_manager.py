@@ -73,11 +73,6 @@ class ProcessManager:
             child = record["process"]
             self._escalating.append(child)
         child.terminate()
-        if self._terminated:
-            # shutdown path: a daemon escalation thread would die with
-            # the interpreter, so escalate inline (blocking is fine here)
-            self._reap(child, timeout)
-            return
         threading.Thread(target=self._reap, args=(child, timeout),
                          name=f"process-manager-kill-{process_id}",
                          daemon=True).start()
@@ -122,19 +117,23 @@ class ProcessManager:
 
     def terminate(self, grace: float = 5.0) -> None:
         """Shutdown path must not rely on daemon escalation threads (they
-        die with the interpreter): give every already-SIGTERMed child a
-        bounded shared grace to exit cleanly, then SIGKILL stragglers so
-        no SIGTERM-ignoring child survives as an orphan."""
+        die with the interpreter): all children get SIGTERM concurrently,
+        then ONE shared grace window to exit cleanly, then SIGKILL for
+        stragglers -- so no SIGTERM-ignoring child survives as an orphan
+        and shutdown is bounded by `grace`, not grace-per-child."""
         import time
-        self._terminated = True
-        self.kill_all()
+        self._terminated = True    # stops the monitor loop
+        self.kill_all()            # concurrent SIGTERM + async reaps
         deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._escalating:
+                    return
+            time.sleep(0.02)
         with self._lock:
             stragglers = list(self._escalating)
             self._escalating.clear()
         for child in stragglers:
-            try:
-                child.wait(max(0.0, deadline - time.monotonic()))
-            except subprocess.TimeoutExpired:
+            if child.poll() is None:
                 child.kill()
-                child.wait()
+            child.wait()

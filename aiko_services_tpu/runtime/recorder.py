@@ -33,7 +33,6 @@ class Recorder(Actor):
         self.ring_size = ring_size
         self.topic_rings = LRUCache(TOPIC_CACHE_SIZE)
         self.share.update({"topic_count": 0, "record_count": 0})
-        ECProducer(self)
         self._record_count = 0
         self.add_message_handler(self._log_handler, self.log_topic_pattern)
 

@@ -46,7 +46,6 @@ class Registrar(Actor):
             "service_count": 0,
             "time_started": repr(self.time_started),
         })
-        ECProducer(self)
 
         self._boot_topic = process.topic_path_registrar_boot
         self._state_pattern = f"{process.namespace}/+/+/+/state"

@@ -13,7 +13,8 @@
 
 from __future__ import annotations
 
-from ..utils import get_logger
+from ..utils import get_logger, get_service_logger, dispose_service_logger
+from .connection import ConnectionState
 
 __all__ = [
     "ServiceProtocol", "ServiceFields", "ServiceFilter", "ServiceTags",
@@ -253,6 +254,14 @@ class Service:
         self.service_id = None      # assigned by process.add_service
         self.topic_path = None
         process.add_service(self)
+        # distributed logging (reference logger.py:127-172, process.py:103-
+        # 114): records buffer in a ring until the transport connects, then
+        # stream to {topic_path}/log; a Recorder or dashboard subscribes
+        self.logger, self._log_ring = get_service_logger(self.topic_path)
+        import threading
+        self._log_tls = threading.local()  # per-thread recursion guard
+        if self._log_ring is not None:
+            process.connection.add_handler(self._log_connection_handler)
 
     # topic quintet (reference service.py:535-551)
     @property
@@ -281,6 +290,25 @@ class Service:
             protocol=self.protocol, transport=self.process.transport_kind,
             owner=self.owner, tags=self.tags)
 
+    # -- distributed logging ----------------------------------------------
+
+    def _log_connection_handler(self, connection, state) -> None:
+        if connection.is_connected(ConnectionState.TRANSPORT):
+            self._log_ring.attach_sink(self._publish_log_record)
+        else:
+            self._log_ring.detach_sink()
+
+    def _publish_log_record(self, text: str) -> None:
+        # per-thread guard: a transport that logs DURING publish must not
+        # recurse, while concurrent logging from other threads still flows
+        if getattr(self._log_tls, "publishing", False):
+            return
+        self._log_tls.publishing = True
+        try:
+            self.process.publish(self.topic_log, text)
+        finally:
+            self._log_tls.publishing = False
+
     def add_tags(self, tags) -> None:
         for tag in tags:
             if tag not in self.tags:
@@ -294,4 +322,9 @@ class Service:
         self.process.remove_message_handler(handler, topic)
 
     def stop(self) -> None:
+        if self._log_ring is not None:
+            self.process.connection.remove_handler(
+                self._log_connection_handler)
+            self._log_ring.detach_sink()
+        dispose_service_logger(self.logger)
         self.process.remove_service(self)

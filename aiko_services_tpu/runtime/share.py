@@ -74,8 +74,18 @@ class ECProducer:
             service, "share", {})
         self._leases: dict[str, Lease] = {}  # response_topic -> Lease
         self._change_handlers: list = []
+        # every Actor auto-creates a producer (reference actor.py:199-205);
+        # an explicit later ECProducer(service) replaces it cleanly
+        previous = getattr(service, "ec_producer", None)
+        if previous is not None:
+            previous.terminate()
         service.ec_producer = self
         service.add_tags(["ec=true"])
+        # services opt into change notifications (e.g. Actor's live
+        # log_level hook) by defining _ec_change_hook
+        hook = getattr(service, "_ec_change_hook", None)
+        if hook is not None:
+            self.add_change_handler(hook)
 
     def handles(self, command: str) -> bool:
         return command in _EC_COMMANDS

@@ -8,7 +8,9 @@ from .config import (                                       # noqa: F401
 from .lru_cache import LRUCache                             # noqa: F401
 from .timeutil import (                                     # noqa: F401
     epoch_now, epoch_to_iso, iso_to_epoch, monotonic)
-from .logger import get_logger, RingBufferHandler           # noqa: F401
+from .logger import (get_logger, get_service_logger,        # noqa: F401
+                     dispose_service_logger,
+                     distributed_logging_enabled, RingBufferHandler)
 from .importer import load_module                           # noqa: F401
 from .padding import bucket_length, pad_axis_to             # noqa: F401,E402
 from .network import get_network_ports_listen               # noqa: F401,E402

@@ -65,8 +65,10 @@ class Checkpointer:
         else:
             # orbax rejects empty pytrees ("Found empty item"); a
             # metadata-only checkpoint (e.g. stream cursors with no
-            # ComputeElement state) is still valid
+            # ComputeElement state) is still valid -- marked explicitly so
+            # restore() can tell it apart from a LOST state payload
             staging.mkdir(parents=True, exist_ok=True)
+            (staging / "no_state").touch()
         (staging / "metadata.json").write_text(metadata_text)
         target = self._step_dir(step)
         if target.exists():
@@ -90,8 +92,13 @@ class Checkpointer:
             try:
                 if (target / "state").exists():
                     pytree = self._checkpointer.restore(target / "state")
+                elif (target / "no_state").exists():
+                    pytree = None  # legit metadata-only checkpoint
                 else:
-                    pytree = None  # metadata-only checkpoint
+                    # state payload lost: treat the step as corrupt so
+                    # step=None falls back to an older intact step
+                    raise FileNotFoundError(
+                        f"state payload missing in {target}")
                 metadata = json.loads(
                     (target / "metadata.json").read_text())
             except Exception as error:  # corrupt step: try the previous
