@@ -9,7 +9,8 @@ from .toys import (                                           # noqa: F401
 from .compute import (                                        # noqa: F401
     ArraySource, TokenSource, MultiModalSource, JaxScale, JaxMLP, ToHost)
 from .ml import (                                             # noqa: F401
-    LMForward, LMGenerate, SpeechToText, Detector, TokensToText)
+    LMForward, LMGenerate, SpeechToText, Detector, TokensToText,
+    TextToTokens)
 from .image_io import (                                       # noqa: F401
     ImageReadFile, ImageSource, ImageResize, ImageOverlay, ImageWriteFile,
     ImageOutput)

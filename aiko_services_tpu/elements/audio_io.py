@@ -67,9 +67,15 @@ class AudioWriteFile(DataTarget):
 class ToneSource(DataSource):
     """Synthetic audio source: items are [frequency_hz, seconds] pairs --
     the hermetic stand-in for PE_Microphone* (reference audio_io.py:196+,
-    which needs pyaudio/sounddevice hardware)."""
+    which needs pyaudio/sounddevice hardware).  on_device=true synthesizes
+    the tone in HBM (no host->device hop on the frame path)."""
 
     def read_item(self, stream, item) -> dict:
+        if self.get_parameter("on_device", False, stream):
+            import jax.numpy as jnp
+            t = (jnp.arange(int(float(item[1]) * SAMPLE_RATE))
+                 / SAMPLE_RATE)
+            return {"audio": jnp.sin(2 * jnp.pi * float(item[0]) * t)}
         return {"audio": synthesize_tone(float(item[0]), float(item[1]))}
 
 

@@ -54,7 +54,19 @@ class Sample(PipelineElement):
 
 
 class DataSource(PipelineElement):
-    """Subclasses implement read_item(stream, item) -> frame_data dict."""
+    """Subclasses implement read_item(stream, item) -> frame_data dict.
+
+    Parameters (all stream-overridable):
+      data_sources     items / paths / globs
+      rate             frames per second throttle
+      count            total frames to emit, cycling items (default: one
+                       pass over the items)
+      data_batch_size  stack N read_item results per frame (reference
+                       common_io.py data_batch_size); ndarray values get a
+                       leading batch axis
+      timestamps       add "t0" (time.time()) to every frame -- declare a
+                       "t0" output port to propagate it (latency probes)
+    """
 
     def emission_index(self, stream) -> int:
         """Monotonic per-stream emission counter.  Use this (not
@@ -73,12 +85,19 @@ class DataSource(PipelineElement):
             return StreamEvent.ERROR, {"diagnostic": "no data_sources"}
         rate = self.get_parameter("rate", None, stream)
         rate = float(rate) if rate else None
-        stream.variables[f"{self.definition.name}.items"] = items
-        if len(items) == 1 and rate is None:
+        count = self.get_parameter("count", None, stream)
+        batch = int(self.get_parameter("data_batch_size", 1, stream))
+        name = self.definition.name
+        stream.variables[f"{name}.items"] = items
+        stream.variables[f"{name}.remaining"] = (
+            int(count) if count is not None
+            else max(1, len(items) // max(batch, 1)))
+        if (len(items) == 1 and rate is None and batch == 1
+                and count is None):
             # fast path: single item, no generator thread
             # (reference common_io.py:96-102)
             try:
-                frame_data = self.read_item(stream, items[0])
+                frame_data = self._read_frame(stream)
             except Exception as error:
                 return StreamEvent.ERROR, {"diagnostic": str(error)}
             self.create_frame(stream, frame_data)
@@ -86,14 +105,43 @@ class DataSource(PipelineElement):
         self.create_frames(stream, self._frame_generator, rate=rate)
         return StreamEvent.OKAY, None
 
+    def _read_frame(self, stream) -> dict:
+        """One frame's data: `data_batch_size` read_item()s stacked."""
+        import time
+
+        import numpy as np
+
+        name = self.definition.name
+        items = stream.variables[f"{name}.items"]
+        batch = int(self.get_parameter("data_batch_size", 1, stream))
+        cursor_key = f"{name}.cursor"
+        parts = []
+        for _ in range(max(batch, 1)):
+            cursor = stream.variables.get(cursor_key, 0)
+            stream.variables[cursor_key] = cursor + 1
+            parts.append(self.read_item(stream,
+                                        items[cursor % len(items)]))
+        if batch <= 1:
+            frame_data = parts[0]
+        else:
+            frame_data = {}
+            for key in parts[0]:
+                values = [part[key] for part in parts]
+                frame_data[key] = (np.stack(values)
+                                   if isinstance(values[0], np.ndarray)
+                                   else values)
+        if self.get_parameter("timestamps", False, stream):
+            frame_data["t0"] = time.time()
+        return frame_data
+
     def _frame_generator(self, stream, frame_id):
-        items = stream.variables[f"{self.definition.name}.items"]
-        cursor_key = f"{self.definition.name}.cursor"
-        cursor = stream.variables.get(cursor_key, 0)
-        if cursor >= len(items):
+        name = self.definition.name
+        remaining_key = f"{name}.remaining"
+        remaining = stream.variables.get(remaining_key, 0)
+        if remaining <= 0:
             return StreamEvent.STOP, {"diagnostic": "data sources exhausted"}
-        stream.variables[cursor_key] = cursor + 1
-        return StreamEvent.OKAY, self.read_item(stream, items[cursor])
+        stream.variables[remaining_key] = remaining - 1
+        return StreamEvent.OKAY, self._read_frame(stream)
 
     def read_item(self, stream, item) -> dict:
         raise NotImplementedError

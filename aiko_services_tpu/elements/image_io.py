@@ -42,11 +42,21 @@ class ImageReadFile(DataSource):
 
 class ImageSource(DataSource):
     """Synthetic image source: items are [channels, height, width] shapes
-    (deterministic, seeded) -- the hermetic stand-in for cameras."""
+    (deterministic, seeded) -- the hermetic stand-in for cameras.
+
+    on_device=true synthesizes with jax.random directly in HBM (no
+    host->device transfer rides the frame path -- the framework's
+    HBM-resident design property; benchmarks use this to measure the
+    compute ceiling rather than host ingest bandwidth)."""
 
     def read_item(self, stream, item) -> dict:
         seed = (int(self.get_parameter("seed", 0, stream))
                 + self.emission_index(stream))
+        if self.get_parameter("on_device", False, stream):
+            import jax
+            key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+            shape = tuple(int(size) for size in item)
+            return {"image": jax.random.uniform(key, shape)}
         return {"image": synthesize_image(item, seed)}
 
 

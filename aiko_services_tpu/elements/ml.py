@@ -12,21 +12,45 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dataclasses import replace
+
 from ..models import (
-    AsrConfig, DetectorConfig, TransformerConfig, count_params, detect,
-    forward, generate, init_asr_params, init_detector_params, init_params,
-    transcribe)
+    AsrConfig, BPETokenizer, DetectorConfig, TransformerConfig,
+    count_params, detect, forward, generate, generate_stream,
+    init_asr_params, init_detector_params, init_params, load_llama_params,
+    load_pytree, transcribe)
+from ..models import configs as model_configs
 from ..ops import log_mel_spectrogram
 from ..pipeline import ComputeElement, PipelineElement, StreamEvent
 from ..utils import get_logger
 
 __all__ = ["LMForward", "LMGenerate", "SpeechToText", "Detector",
-           "TokensToText"]
+           "TokensToText", "TextToTokens"]
 
 _LOGGER = get_logger("ml_elements")
 
+# "preset" parameter values -> reference-scale configs (configs.py)
+_LM_PRESETS = {
+    "llama3_8b": model_configs.LLAMA3_8B,
+    "llama32_1b": model_configs.LLAMA32_1B,
+    "toy": model_configs.LM_TOY,
+}
+_ASR_PRESETS = {
+    "whisper_tiny": model_configs.WHISPER_TINY,
+    "whisper_small": model_configs.WHISPER_SMALL,
+}
+_DETECTOR_PRESETS = {
+    "yolov8n": model_configs.YOLOV8N_SHAPE,
+    "toy": model_configs.DETECTOR_TOY,
+}
+
 
 def _transformer_config(element) -> TransformerConfig:
+    preset = element.get_parameter("preset")
+    if preset:
+        config = _LM_PRESETS[str(preset)]
+        dtype = element.get_parameter("dtype")
+        return replace(config, dtype=str(dtype)) if dtype else config
     return TransformerConfig(
         vocab_size=int(element.get_parameter("vocab_size", 8192)),
         d_model=int(element.get_parameter("d_model", 512)),
@@ -39,6 +63,36 @@ def _transformer_config(element) -> TransformerConfig:
     )
 
 
+def _load_transformer_params(element, config: TransformerConfig):
+    """weights parameter: path to a safetensors checkpoint -- HuggingFace
+    Llama naming (elements_llm.py:137-179 capability) or this framework's
+    native save_pytree layout; absent -> seeded random init."""
+    weights = element.get_parameter("weights")
+    if weights:
+        paths = weights if isinstance(weights, list) else [weights]
+        from ..models import SafetensorsFile
+        probe = SafetensorsFile(paths[0])
+        is_hf = "model.embed_tokens.weight" in probe
+        probe.close()
+        if is_hf:
+            return load_llama_params(paths, config)
+        return load_pytree(paths[0], dtype=config.dtype)
+    return init_params(
+        config, jax.random.PRNGKey(int(element.get_parameter("seed", 0))))
+
+
+def _tokenizer_for(element) -> BPETokenizer | None:
+    """tokenizer parameter: "default" (the committed BPE asset), a path to
+    a tokenizer json (ours or HuggingFace tokenizer.json), or unset ->
+    None (byte-level toy vocabulary)."""
+    source = element.get_parameter("tokenizer")
+    if not source:
+        return None
+    if source == "default":
+        return BPETokenizer.default()
+    return BPETokenizer.from_file(source)
+
+
 class LMForward(ComputeElement):
     """tokens (B, L) -> logits (B, L, V) + per-sequence mean NLL.
 
@@ -48,9 +102,7 @@ class LMForward(ComputeElement):
 
     def setup(self):
         self.config = _transformer_config(self)
-        params = init_params(
-            self.config,
-            jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+        params = _load_transformer_params(self, self.config)
         _LOGGER.info("%s: transformer %.1fM params",
                      self.definition.name, count_params(params) / 1e6)
         return params
@@ -72,16 +124,46 @@ class LMGenerate(ComputeElement):
 
     def setup(self):
         self.config = _transformer_config(self)
-        return init_params(
-            self.config,
-            jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+        self.tokenizer = _tokenizer_for(self)
+        return _load_transformer_params(self, self.config)
 
-    def process_frame(self, stream, tokens):
+    def process_frame(self, stream, tokens=None, text=None):
         self._ensure_ready()
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
+        if tokens is None:
+            if text is None:
+                raise ValueError("LMGenerate needs tokens or text input")
+            prompts = [text] if isinstance(text, str) else list(text)
+            if self.tokenizer is None:
+                raise ValueError("text input needs a tokenizer parameter")
+            encoded = [self.tokenizer.encode(p, bos=True) for p in prompts]
+            width = max(len(ids) for ids in encoded)
+            pad = self.tokenizer.pad_id or 0
+            tokens = np.full((len(encoded), width), pad, np.int32)
+            for row, ids in enumerate(encoded):
+                tokens[row, width - len(ids):] = ids  # left-pad
         tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
-        out, _ = generate(self.state, self.config, tokens, max_new)
-        return StreamEvent.OKAY, {"generated": out}
+        if bool(self.get_parameter("stream_tokens", False, stream)):
+            # streamed serving path: publish token chunks to /out as they
+            # decode (reference capability: Ollama token streaming)
+            chunk = int(self.get_parameter("stream_chunk", 8, stream))
+            blocks = []
+            for offset, block in generate_stream(
+                    self.state, self.config, tokens, max_new, chunk=chunk):
+                blocks.append(block)
+                payload = block.tolist()
+                if self.tokenizer is not None:
+                    payload = [self.tokenizer.decode(row) for row in block]
+                self.publish_out("tokens",
+                                 [stream.stream_id, offset, payload])
+            out = np.concatenate(blocks, axis=1)
+        else:
+            out, _ = generate(self.state, self.config, tokens, max_new)
+        result = {"generated": out}
+        if self.tokenizer is not None:
+            result["text"] = [self.tokenizer.decode(np.asarray(row))
+                              for row in np.asarray(out)]
+        return StreamEvent.OKAY, result
 
     def compute(self, state, **inputs):  # pragma: no cover
         raise NotImplementedError("LMGenerate overrides process_frame")
@@ -101,18 +183,29 @@ class SpeechToText(ComputeElement):
     """
 
     def setup(self):
-        self.config = AsrConfig(
-            d_model=int(self.get_parameter("d_model", 384)),
-            enc_layers=int(self.get_parameter("enc_layers", 4)),
-            dec_layers=int(self.get_parameter("dec_layers", 4)),
-            n_heads=int(self.get_parameter("n_heads", 6)),
-            vocab_size=int(self.get_parameter("vocab_size", 1024)),
-            max_frames=int(self.get_parameter("max_frames", 1500)),
-            dtype=str(self.get_parameter("dtype", "bfloat16")),
-        )
-        params = init_asr_params(
-            self.config,
-            jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+        preset = self.get_parameter("preset")
+        if preset:
+            self.config = _ASR_PRESETS[str(preset)]
+            dtype = self.get_parameter("dtype")
+            if dtype:
+                self.config = replace(self.config, dtype=str(dtype))
+        else:
+            self.config = AsrConfig(
+                d_model=int(self.get_parameter("d_model", 384)),
+                enc_layers=int(self.get_parameter("enc_layers", 4)),
+                dec_layers=int(self.get_parameter("dec_layers", 4)),
+                n_heads=int(self.get_parameter("n_heads", 6)),
+                vocab_size=int(self.get_parameter("vocab_size", 1024)),
+                max_frames=int(self.get_parameter("max_frames", 1500)),
+                dtype=str(self.get_parameter("dtype", "bfloat16")),
+            )
+        weights = self.get_parameter("weights")
+        if weights:
+            params = load_pytree(weights, dtype=self.config.dtype)
+        else:
+            params = init_asr_params(
+                self.config,
+                jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
         _LOGGER.info("%s: ASR %.1fM params", self.definition.name,
                      count_params(params) / 1e6)
         return params
@@ -130,17 +223,47 @@ class SpeechToText(ComputeElement):
 
 
 class TokensToText(PipelineElement):
-    """tokens (B, T) -> text list[str] via the byte-level toy vocabulary
-    (explicit host boundary: this is where token ids leave the device)."""
+    """tokens (B, T) -> text list[str] (explicit host boundary: this is
+    where token ids leave the device).  With a "tokenizer" parameter
+    ("default" or a path) decoding uses the real BPE vocabulary; without
+    one, the byte-level toy vocabulary."""
 
     def process_frame(self, stream, tokens):
         token_array = np.asarray(tokens)
+        tokenizer = _tokenizer_for(self)
         texts = []
         for row in token_array:
-            data = bytes(int(t) - _BYTE_OFFSET for t in row
-                         if _BYTE_OFFSET <= t < _BYTE_OFFSET + 256)
-            texts.append(data.decode("utf-8", errors="replace"))
+            if tokenizer is not None:
+                texts.append(tokenizer.decode(row))
+            else:
+                data = bytes(int(t) - _BYTE_OFFSET for t in row
+                             if _BYTE_OFFSET <= t < _BYTE_OFFSET + 256)
+                texts.append(data.decode("utf-8", errors="replace"))
         return StreamEvent.OKAY, {"text": texts}
+
+
+class TextToTokens(PipelineElement):
+    """text (str | list[str]) -> token ids (B, T) int32, left-padded.
+
+    The host->device tokenization boundary feeding LMForward/LMGenerate;
+    "tokenizer" parameter as in TokensToText (defaults to the committed
+    BPE asset)."""
+
+    def process_frame(self, stream, text):
+        tokenizer = _tokenizer_for(self) or BPETokenizer.default()
+        prompts = [text] if isinstance(text, str) else list(text)
+        bos = bool(self.get_parameter("bos", True, stream))
+        encoded = [tokenizer.encode(p, bos=bos) for p in prompts]
+        max_len = self.get_parameter("max_len", None, stream)
+        width = max(len(ids) for ids in encoded) if encoded else 1
+        if max_len:
+            width = int(max_len)
+            encoded = [ids[-width:] for ids in encoded]
+        pad = tokenizer.pad_id or 0
+        tokens = np.full((len(encoded), max(width, 1)), pad, np.int32)
+        for row, ids in enumerate(encoded):
+            tokens[row, tokens.shape[1] - len(ids):] = ids
+        return StreamEvent.OKAY, {"tokens": tokens}
 
 
 class Detector(ComputeElement):
@@ -150,18 +273,30 @@ class Detector(ComputeElement):
     produced lazily by ImageOverlay/host sinks."""
 
     def setup(self):
-        self.config = DetectorConfig(
-            n_classes=int(self.get_parameter("n_classes", 16)),
-            base_channels=int(self.get_parameter("base_channels", 32)),
-            image_size=int(self.get_parameter("image_size", 256)),
-            max_detections=int(self.get_parameter("max_detections", 32)),
-            score_threshold=float(
-                self.get_parameter("score_threshold", 0.25)),
-            dtype=str(self.get_parameter("dtype", "bfloat16")),
-        )
-        params = init_detector_params(
-            self.config,
-            jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+        preset = self.get_parameter("preset")
+        if preset:
+            self.config = _DETECTOR_PRESETS[str(preset)]
+            dtype = self.get_parameter("dtype")
+            if dtype:
+                self.config = replace(self.config, dtype=str(dtype))
+        else:
+            self.config = DetectorConfig(
+                n_classes=int(self.get_parameter("n_classes", 16)),
+                base_channels=int(self.get_parameter("base_channels", 32)),
+                image_size=int(self.get_parameter("image_size", 256)),
+                max_detections=int(
+                    self.get_parameter("max_detections", 32)),
+                score_threshold=float(
+                    self.get_parameter("score_threshold", 0.25)),
+                dtype=str(self.get_parameter("dtype", "bfloat16")),
+            )
+        weights = self.get_parameter("weights")
+        if weights:
+            params = load_pytree(weights, dtype=self.config.dtype)
+        else:
+            params = init_detector_params(
+                self.config,
+                jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
         _LOGGER.info("%s: detector %.1fM params", self.definition.name,
                      count_params(params) / 1e6)
         return params

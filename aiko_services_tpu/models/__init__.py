@@ -1,6 +1,15 @@
 from .transformer import (                                    # noqa: F401
     TransformerConfig, init_params, param_specs, forward, init_cache,
-    cache_specs, decode_step, generate, make_train_step, count_params)
+    cache_specs, decode_step, generate, generate_stream, make_train_step,
+    count_params)
+from .tokenizer import BPETokenizer, train_bpe                # noqa: F401
+from .weights import (                                        # noqa: F401
+    read_safetensors, write_safetensors, SafetensorsFile, save_pytree,
+    load_pytree, load_llama_params)
+from .configs import (                                        # noqa: F401
+    LLAMA3_8B, LLAMA32_1B, LM_TOY, WHISPER_TINY, WHISPER_SMALL,
+    YOLOV8N_SHAPE, DETECTOR_TOY, transformer_flops_per_token,
+    asr_flops_per_example, detector_flops_per_image)
 from .asr import (                                            # noqa: F401
     AsrConfig, init_asr_params, asr_param_specs, encode_audio,
     decode_tokens, asr_forward, transcribe)

@@ -59,8 +59,12 @@ def init_detector_params(config: DetectorConfig, key) -> dict:
 
 
 def detector_forward(params: dict, config: DetectorConfig, images):
-    """images (B, 3, H, W) in [0, 1] -> raw head (B, 5+C, H/16, W/16)."""
-    x = images.astype(config.jnp_dtype)
+    """images (B, 3, H, W) in [0, 1] -> raw head (B, 5+C, H/16, W/16).
+
+    Public contract stays channels-first; internally ONE transpose to NHWC
+    at entry and one back at exit so every conv runs channels-last on the
+    MXU (layers.py conv2d)."""
+    x = images.astype(config.jnp_dtype).transpose(0, 2, 3, 1)  # -> NHWC
     x = jax.nn.silu(conv2d(params["stem"], x, stride=2))
     x = jax.nn.silu(conv2d(params["stage1"], x, stride=2))
     x = x + jax.nn.silu(conv2d(params["block1"], x))
@@ -68,7 +72,7 @@ def detector_forward(params: dict, config: DetectorConfig, images):
     x = x + jax.nn.silu(conv2d(params["block2"], x))
     x = jax.nn.silu(conv2d(params["stage3"], x, stride=2))
     x = x + jax.nn.silu(conv2d(params["block3"], x))
-    return conv2d(params["head"], x)
+    return conv2d(params["head"], x).transpose(0, 3, 1, 2)  # -> NCHW
 
 
 def decode_boxes(raw, config: DetectorConfig):

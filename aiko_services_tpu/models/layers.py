@@ -97,7 +97,7 @@ def init_conv(key, in_channels: int, out_channels: int, kernel: int,
               dtype=jnp.float32, bias: bool = True) -> dict:
     fan_in = in_channels * kernel * kernel
     params = {"w": (jax.random.normal(
-        key, (out_channels, in_channels, kernel, kernel), jnp.float32)
+        key, (kernel, kernel, in_channels, out_channels), jnp.float32)
         / np.sqrt(fan_in)).astype(dtype)}
     if bias:
         params["b"] = jnp.zeros((out_channels,), dtype)
@@ -105,12 +105,16 @@ def init_conv(key, in_channels: int, out_channels: int, kernel: int,
 
 
 def conv2d(params: dict, x, stride: int = 1, padding="SAME"):
-    """x (B, C, H, W), w (O, I, kh, kw) -> (B, O, H', W')."""
+    """x (B, H, W, C), w (kh, kw, I, O) -> (B, H', W', O).
+
+    NHWC/HWIO: channels ride the TPU lane dimension so XLA maps the conv
+    onto the MXU directly (NCHW forces layout shuffles that collapse conv
+    throughput ~100x on TPU -- measured in bench.py round 2)."""
     out = jax.lax.conv_general_dilated(
         x, params["w"].astype(x.dtype),
         window_strides=(stride, stride), padding=padding,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=jnp.float32)
     if "b" in params:
-        out = out + params["b"].astype(jnp.float32)[None, :, None, None]
+        out = out + params["b"].astype(jnp.float32)
     return out.astype(x.dtype)

@@ -32,7 +32,7 @@ from .layers import (
 __all__ = [
     "TransformerConfig", "init_params", "param_specs", "forward",
     "init_cache", "cache_specs", "decode_step", "generate",
-    "make_train_step", "count_params",
+    "generate_stream", "make_train_step", "count_params",
 ]
 
 
@@ -113,10 +113,13 @@ def init_params(config: TransformerConfig, key) -> dict:
     }
 
 
-def param_specs(config: TransformerConfig) -> dict:
+def param_specs(config: TransformerConfig,
+                lm_head: bool = False) -> dict:
     """Megatron TP on 'model' + FSDP on 'fsdp' (+ EP on 'expert' for MoE
     weights); stacked-layer leaves carry a leading None for the scan axis.
-    (Scaling-book recipe: shard the big matmuls, replicate the norms.)"""
+    (Scaling-book recipe: shard the big matmuls, replicate the norms.)
+    lm_head=True adds the untied-output-head spec (checkpoint-loaded
+    Llama-3-8B+ params carry one)."""
     layer = {
         "attn_norm": {"scale": P(None, None)},
         "wq": {"w": P(None, "fsdp", "model")},
@@ -134,11 +137,14 @@ def param_specs(config: TransformerConfig) -> dict:
         layer["w_gate"] = {"w": P(None, "fsdp", "model")}
         layer["w_up"] = {"w": P(None, "fsdp", "model")}
         layer["w_down"] = {"w": P(None, "model", "fsdp")}
-    return {
+    specs = {
         "embed": {"w": P(None, "fsdp")},
         "layers": layer,
         "norm_out": {"scale": P(None)},
     }
+    if lm_head:
+        specs["lm_head"] = {"w": P(None, "fsdp")}
+    return specs
 
 
 def count_params(params) -> int:
@@ -286,8 +292,11 @@ def forward(params: dict, config: TransformerConfig, tokens,
         h, new_cache = jax.lax.scan(layer_step, h,
                                     (params["layers"], cache))
     h = rms_norm(params["norm_out"], h, config.norm_eps)
+    # untied output head when the checkpoint ships one (Llama-3-8B+,
+    # models/weights.py load_llama_params); tied embedding otherwise
+    head = params.get("lm_head", params["embed"])["w"]
     logits = jnp.einsum("bld,vd->blv", h.astype(jnp.float32),
-                        params["embed"]["w"].astype(jnp.float32))
+                        head.astype(jnp.float32))
     if new_cache is None:
         return logits
     return logits, new_cache
@@ -342,6 +351,59 @@ def generate(params, config: TransformerConfig, prompt,
                            max_len=prompt_len + max_new_tokens)
     return _generate_compiled(params, config, prompt, cache,
                               int(max_new_tokens))
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(3,))
+def _prefill_step(params, config: TransformerConfig, prompt, cache):
+    logits, cache = forward(params, config, prompt, cache=cache, pos=0)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    return first[:, None], cache
+
+
+@partial(jax.jit, static_argnames=("config", "chunk"), donate_argnums=(3,))
+def _decode_chunk(params, config: TransformerConfig, token, cache, pos,
+                  chunk: int):
+    """`chunk` greedy steps as ONE device program (lax.fori_loop): one
+    dispatch per chunk, so host/tunnel latency never rides per-token."""
+    batch = token.shape[0]
+    out = jnp.zeros((batch, chunk), jnp.int32)
+
+    def body(step, carry):
+        out, token, cache = carry
+        logits, cache = forward(params, config, token, cache=cache,
+                                pos=pos + step)
+        token = jnp.argmax(logits[:, -1], axis=-1).astype(
+            jnp.int32)[:, None]
+        out = jax.lax.dynamic_update_slice(out, token, (0, step))
+        return out, token, cache
+
+    out, token, cache = jax.lax.fori_loop(0, chunk, body,
+                                          (out, token, cache))
+    return out, token, cache
+
+
+def generate_stream(params, config: TransformerConfig, prompt,
+                    max_new_tokens: int, cache=None, chunk: int = 8):
+    """Streaming greedy generation: yields (offset, tokens (B, n)) numpy
+    chunks as they decode -- the serving path behind LMGenerate's streamed
+    token output (reference capability: Ollama token streaming,
+    elements_llm.py:137-179).  Prefill is one jit; decode runs in
+    on-device chunks of `chunk` steps, so the host sees one dispatch +
+    one transfer per chunk."""
+    batch, prompt_len = prompt.shape
+    if cache is None:
+        cache = init_cache(config, batch,
+                           max_len=prompt_len + max_new_tokens)
+    token, cache = _prefill_step(params, config, prompt, cache)
+    yield 0, jax.device_get(token)
+    produced = 1
+    while produced < max_new_tokens:
+        size = min(chunk, max_new_tokens - produced)
+        block, token, cache = _decode_chunk(
+            params, config, token, cache,
+            jnp.int32(prompt_len + produced - 1), int(size))
+        yield produced, jax.device_get(block)
+        produced += size
 
 
 # -- training ---------------------------------------------------------------

@@ -66,7 +66,8 @@ def attention_reference(q, k, v, causal: bool = False, sm_scale=None,
 _STAT_LANES = 128  # min f32 lane width for the m/l scratch tiles
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *,
                   causal: bool, sm_scale: float, kv_len: int, q_offset: int):
     """One (batch*head, q_block, k_block) grid step of the online-softmax
     recurrence.  K/V stream through VMEM one block per step (HBM->VMEM via
@@ -123,6 +124,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finish():
         o_ref[0] = (acc_ref[:] / jnp.maximum(
             l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        # per-row logsumexp: the only forward residual the backward
+        # kernels need beyond q/k/v/o
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _pad_seq(x, block: int):
@@ -139,10 +143,10 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
     q_offset shifts the causal mask for callers whose q shard starts at a
     nonzero global position (ring attention resumes, KV-cached decode).
 
-    Differentiable: forward is the Pallas kernel; the backward pass
-    recomputes attention with the XLA reference (O(L^2) memory in backward
-    only -- the flash memory win applies to inference and the forward pass
-    of training).
+    Differentiable end-to-end in Pallas: the forward kernel saves the
+    per-row logsumexp, and the backward pass runs two blockwise kernels
+    (dq; dk/dv) that recompute p inside VMEM -- backward peak memory is
+    O(L x block), never O(L^2).
     """
     batch, heads, q_len, head_dim = q.shape
     kv_len = k.shape[2]
@@ -156,23 +160,22 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale=None,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
-    return _flash_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       q_offset)
+    out, _ = _flash_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                         q_offset)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
-    out = _flash_impl(q, k, v, causal, sm_scale, block_q, block_k, q_offset)
-    return out, (q, k, v)
+    out, lse = _flash_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                           q_offset)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, residuals,
                cotangent):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: attention_reference(
-            q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset),
-        q, k, v)
-    return vjp(cotangent)
+    q, k, v, out, lse = residuals
+    return _flash_bwd_impl(q, k, v, out, lse, cotangent, causal, sm_scale,
+                           block_q, block_k, q_offset)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -202,7 +205,7 @@ def _flash_impl(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
         _flash_kernel,
         causal=causal, sm_scale=float(sm_scale), kv_len=kv_len,
         q_offset=int(q_offset) + (kv_len - q_len if causal else 0))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -216,11 +219,20 @@ def _flash_impl(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
                          lambda bh, qi, ki: (bh, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0),
-            memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            (batch * heads, padded_q_len, head_dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0),
+                memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, block_q, _STAT_LANES), lambda bh, qi, ki: (bh, qi, 0),
+                memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (batch * heads, padded_q_len, head_dim), q.dtype),
+            jax.ShapeDtypeStruct(
+                (batch * heads, padded_q_len, _STAT_LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),   # m
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),   # l
@@ -230,7 +242,228 @@ def _flash_impl(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q_padded, k_padded, v_padded)
-    return out.reshape(batch, heads, padded_q_len, head_dim)[:, :, :q_len]
+    out = out.reshape(batch, heads, padded_q_len, head_dim)[:, :, :q_len]
+    lse = lse.reshape(batch, heads, padded_q_len, _STAT_LANES)[:, :, :q_len,
+                                                               0]
+    return out, lse
+
+
+# -- Pallas flash attention backward ----------------------------------------
+#
+# FlashAttention-2-style: p is recomputed blockwise inside VMEM from the
+# saved logsumexp; dq accumulates over the sequential k dimension, dk/dv
+# over the sequential q dimension.  delta = rowsum(dO * O) is a cheap
+# O(L*D) XLA pass.
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dq_acc_ref, *,
+                     causal: bool, sm_scale: float, kv_len: int,
+                     q_offset: int):
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+    q_base = qi * block_q + q_offset
+    q_pos = (q_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0))
+    k_pos = (ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1))
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    needed = ki * block_k < kv_len
+    if causal:
+        needed = jnp.logical_and(
+            needed, ki * block_k <= q_base + block_q - 1)
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * sm_scale, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_acc_ref[:] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(ki == num_kb - 1)
+    def _finish():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                      causal: bool, sm_scale: float, kv_len: int,
+                      q_len: int, q_offset: int):
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_qb = pl.num_programs(2)
+    q_base = qi * block_q + q_offset
+    # transposed layout: rows are k positions, columns q positions
+    k_pos = (ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0))
+    q_pos = (q_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 1))
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    needed = qi * block_q < q_len
+    if causal:
+        # skip q blocks entirely ABOVE this k block's causal reach
+        needed = jnp.logical_and(
+            needed, q_base + block_q - 1 >= ki * block_k)
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s_t = jax.lax.dot_general(
+            k_blk, q * sm_scale, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (block_k, block_q)
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        lse_row = lse_ref[0][:, 0]                # (block_q,)
+        p_t = jnp.where(mask, jnp.exp(s_t - lse_row[None, :]), 0.0)
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p_t, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(
+            v_blk, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (block_k, block_q)
+        delta_row = delta_ref[0][:, 0]
+        ds_t = p_t * (dp_t - delta_row[None, :])
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(qi == num_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "block_q", "block_k", "q_offset"))
+def _flash_bwd_impl(q, k, v, out, lse, dout, causal, sm_scale, block_q,
+                    block_k, q_offset):
+    batch, heads, q_len, head_dim = q.shape
+    kv_len = k.shape[2]
+    effective_offset = int(q_offset) + (kv_len - q_len if causal else 0)
+
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                               # (B, H, Lq)
+
+    q_p = _pad_seq(q, block_q).reshape(batch * heads, -1, head_dim)
+    do_p = _pad_seq(dout, block_q).reshape(batch * heads, -1, head_dim)
+    k_p = _pad_seq(k, block_k).reshape(batch * heads, -1, head_dim)
+    v_p = _pad_seq(v, block_k).reshape(batch * heads, -1, head_dim)
+    padded_q_len = q_p.shape[1]
+    padded_kv_len = k_p.shape[1]
+
+    def lanes(x, block):  # (B, H, L) -> (B*H, padded L, _STAT_LANES)
+        x = pad_axis_to(x[..., None], 2,
+                        ((x.shape[2] + block - 1) // block) * block)
+        return jnp.broadcast_to(
+            x.reshape(batch * heads, -1, 1),
+            (batch * heads, x.shape[2], _STAT_LANES))
+
+    lse_p = lanes(lse, block_q)
+    delta_p = lanes(delta, block_q)
+
+    q_spec = pl.BlockSpec((1, block_q, head_dim),
+                          lambda bh, qi, ki: (bh, qi, 0),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, block_k, head_dim),
+                          lambda bh, qi, ki: (bh, ki, 0),
+                          memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((1, block_q, _STAT_LANES),
+                             lambda bh, qi, ki: (bh, qi, 0),
+                             memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, causal=causal, sm_scale=float(sm_scale),
+            kv_len=kv_len, q_offset=effective_offset),
+        grid=(batch * heads, padded_q_len // block_q,
+              padded_kv_len // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, stat_spec, stat_spec],
+        out_specs=pl.BlockSpec((1, block_q, head_dim),
+                               lambda bh, qi, ki: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch * heads, padded_q_len, head_dim), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q_p, k_p, v_p, do_p, lse_p, delta_p)
+
+    # dk/dv: k blocks are the parallel dimension, q streams sequentially
+    q_spec_t = pl.BlockSpec((1, block_q, head_dim),
+                            lambda bh, ki, qi: (bh, qi, 0),
+                            memory_space=pltpu.VMEM)
+    k_spec_t = pl.BlockSpec((1, block_k, head_dim),
+                            lambda bh, ki, qi: (bh, ki, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec_t = pl.BlockSpec((1, block_q, _STAT_LANES),
+                               lambda bh, ki, qi: (bh, qi, 0),
+                               memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, causal=causal, sm_scale=float(sm_scale),
+            kv_len=kv_len, q_len=q_len, q_offset=effective_offset),
+        grid=(batch * heads, padded_kv_len // block_k,
+              padded_q_len // block_q),
+        in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, stat_spec_t,
+                  stat_spec_t],
+        out_specs=[
+            pl.BlockSpec((1, block_k, head_dim),
+                         lambda bh, ki, qi: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, head_dim),
+                         lambda bh, ki, qi: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (batch * heads, padded_kv_len, head_dim), k.dtype),
+            jax.ShapeDtypeStruct(
+                (batch * heads, padded_kv_len, head_dim), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, head_dim), jnp.float32),
+                        pltpu.VMEM((block_k, head_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q_p, k_p, v_p, do_p, lse_p, delta_p)
+
+    dq = dq.reshape(batch, heads, padded_q_len, head_dim)[:, :, :q_len]
+    dk = dk.reshape(batch, heads, padded_kv_len, head_dim)[:, :, :kv_len]
+    dv = dv.reshape(batch, heads, padded_kv_len, head_dim)[:, :, :kv_len]
+    return dq, dk, dv
 
 
 # -- Ring attention (sequence parallel) -------------------------------------

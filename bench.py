@@ -1,20 +1,23 @@
-# Benchmark: sustained pipeline throughput with a real transformer LM
-# element on one chip.
+# Benchmark harness: the five BASELINE.json configurations, measured
+# through the real framework path, with MFU per compute stage.
 #
-# Measures end-to-end frames/sec through the FULL framework path (frame
-# generator thread -> pipeline mailbox -> graph execution -> jit-compiled
-# transformer forward on device -> response queue), the TPU analogue of the
-# reference's multitude load test whose observed ceiling was ~50 frames/sec
-# over a localhost MQTT broker (reference: src/aiko_services/examples/
-# pipeline/multitude/run_small.sh:9,21 -- "maximum frame rate before
-# falling behind").  vs_baseline is the ratio against that 50 Hz ceiling.
+#   1 text      single-stage text PipelineElement (CPU-class reference:
+#               the reference multitude ceiling was ~50 frames/sec over a
+#               localhost MQTT broker, run_small.sh:9,21)
+#   2 asr       Whisper-small-shape speech->text element, 1 chip
+#   3 detector  YOLOv8n-shape detection element, batched stream
+#   4 llm       Llama-family decode: time-to-first-token + tokens/sec,
+#               streamed through generate_stream (the serving path)
+#   5 pipeline  3-stage multi-modal graph (speech -> LM, vision ->
+#               detections) end-to-end
 #
-# Tensors stay HBM-resident end to end (the framework's core design
-# property): completion is verified with block_until_ready -- no
-# device->host transfer rides the hot path; one transfer at the end checks
-# numerics.
+# Prints ONE JSON line.  Headline metric = config 5 end-to-end frames/sec
+# (vs_baseline = ratio over the reference's 50 frames/sec pipeline
+# ceiling); per-config results ride in "configs".
 #
-# Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+# Env knobs: AIKO_BENCH_SMOKE=1 shrinks models/frame counts for CPU smoke
+# runs; AIKO_BENCH_CONFIGS=csv subset (e.g. "llm,pipeline");
+# AIKO_BENCH_PEAK_TFLOPS overrides the per-chip peak used for MFU.
 
 from __future__ import annotations
 
@@ -25,89 +28,355 @@ import sys
 import time
 
 REFERENCE_FRAMES_PER_SEC = 50.0  # multitude ceiling, run_small.sh:9
+SMOKE = os.environ.get("AIKO_BENCH_SMOKE", "") not in ("", "0")
 
-# env-overridable for smoke runs on slow backends
-BATCH = int(os.environ.get("AIKO_BENCH_BATCH", 8))
-SEQ_LEN = int(os.environ.get("AIKO_BENCH_SEQ", 128))
-WARMUP_FRAMES = int(os.environ.get("AIKO_BENCH_WARMUP", 20))
-MEASURE_FRAMES = int(os.environ.get("AIKO_BENCH_FRAMES", 200))
-N_LAYERS = int(os.environ.get("AIKO_BENCH_LAYERS", 8))
-D_MODEL = int(os.environ.get("AIKO_BENCH_DMODEL", 512))
+ELEMENTS = "aiko_services_tpu.elements"
 
 
-def main() -> None:
+def _local(class_name):
+    return {"local": {"module": ELEMENTS, "class_name": class_name}}
+
+
+def _peak_flops_per_chip():
     import jax
+    override = os.environ.get("AIKO_BENCH_PEAK_TFLOPS")
+    if override:
+        return float(override) * 1e12
+    kind = jax.devices()[0].device_kind.lower()
+    table = {  # bf16 peak per chip
+        "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v5": 197e12,
+        "v6 lite": 918e12, "v6e": 918e12, "v4": 275e12, "v3": 123e12,
+        "v2": 45e12,
+    }
+    for key, value in table.items():
+        if key in kind:
+            return value
+    return None
 
-    # AIKO_BENCH_PLATFORM=cpu: smoke-test on the host platform (needed when
-    # another process holds the only TPU; env JAX_PLATFORMS alone is not
-    # honored once an accelerator plugin self-registers at import)
-    platform = os.environ.get("AIKO_BENCH_PLATFORM")
-    if platform:
-        jax.config.update("jax_platforms", platform)
 
+def _mfu(flops_per_sec, peak):
+    if not peak or not flops_per_sec:
+        return None
+    return round(flops_per_sec / peak, 4)
+
+
+def _run_pipeline(definition, warmup: int, measure: int,
+                  ready_key: str, timeout: float = 900,
+                  latency_frames: int | None = None):
+    """Drive a pipeline with its own frame generator.
+
+    Two phases: (1) throughput -- the generator keeps the pipeline full
+    (frame_window in flight); (2) latency -- a second stream with
+    frame_window=1, so exactly one frame is in the system and t0 ->
+    completion is true per-frame service latency, not queueing depth.
+    Returns (frames/sec, p50 latency s, last outputs).
+    """
+    import jax
     import numpy as np
 
     from aiko_services_tpu.pipeline import create_pipeline
     from aiko_services_tpu.runtime import Process
 
-    definition = {
-        "name": "bench_lm_pipeline",
-        "graph": ["(source (lm))"],
-        "elements": [
-            {"name": "source",
-             "output": [{"name": "tokens"}, {"name": "t0"}],
-             "parameters": {"data_sources": [[BATCH, SEQ_LEN]],
-                            "count": WARMUP_FRAMES + MEASURE_FRAMES + 8},
-             "deploy": {"local": {
-                 "module": "aiko_services_tpu.elements",
-                 "class_name": "TokenSource"}}},
-            {"name": "lm", "input": [{"name": "tokens"}],
-             "output": [{"name": "logits"}, {"name": "nll"}],
-             "parameters": {"vocab_size": 8192, "d_model": D_MODEL,
-                            "n_layers": N_LAYERS, "n_heads": 8,
-                            "n_kv_heads": 4, "d_ff": 3 * D_MODEL,
-                            "dtype": "bfloat16"},
-             "deploy": {"local": {
-                 "module": "aiko_services_tpu.elements",
-                 "class_name": "LMForward"}}},
-        ],
-    }
+    if latency_frames is None:
+        latency_frames = 5 if SMOKE else 30
 
     process = Process(transport_kind="loopback")
     pipeline = create_pipeline(process, definition)
     process.run(in_thread=True)
     responses = queue.Queue()
     pipeline.create_stream("bench", queue_response=responses,
-                           grace_time=600)
+                           grace_time=1800)
+    for _ in range(warmup):
+        _, _, outputs = responses.get(timeout=timeout)
+        jax.block_until_ready(outputs[ready_key])
+    start = time.perf_counter()
+    for _ in range(measure):
+        _, _, outputs = responses.get(timeout=timeout)
+        jax.block_until_ready(outputs[ready_key])
+    elapsed = time.perf_counter() - start
+    pipeline.destroy_stream("bench")
 
     latencies = []
-    for _ in range(WARMUP_FRAMES):  # covers jit compilation
-        _, _, outputs = responses.get(timeout=600)
-        jax.block_until_ready(outputs["nll"])
-    start = time.perf_counter()
-    last_nll = None
-    for _ in range(MEASURE_FRAMES):
-        _, frame, outputs = responses.get(timeout=600)
-        # device completion, not just dispatch -- but NO host transfer
-        jax.block_until_ready(outputs["nll"])
-        latencies.append(time.time() - outputs["t0"])
-        last_nll = outputs["nll"]
-    elapsed = time.perf_counter() - start
-    nll_host = np.asarray(last_nll)  # single D2H at the end: numerics check
-    pipeline.destroy_stream("bench")
+    lat_responses = queue.Queue()
+    pipeline.create_stream(
+        "latency", queue_response=lat_responses, grace_time=1800,
+        parameters={"frame_window": 1, "count": latency_frames + 2})
+    for index in range(latency_frames):
+        _, _, lat_outputs = lat_responses.get(timeout=timeout)
+        jax.block_until_ready(lat_outputs[ready_key])
+        if "t0" in lat_outputs:
+            latencies.append(time.time() - lat_outputs["t0"])
+    pipeline.destroy_stream("latency")
     process.terminate()
-    assert np.isfinite(nll_host).all(), f"non-finite NLL {nll_host}"
+    p50 = (float(np.percentile(latencies[1:] or latencies, 50))
+           if latencies else elapsed / measure)
+    return measure / elapsed, p50, outputs
 
-    frames_per_sec = MEASURE_FRAMES / elapsed
+
+# -- config 1: text ----------------------------------------------------------
+
+def bench_text():
+    measure = 200 if SMOKE else 2000
+    definition = {
+        "name": "bench_text",
+        "graph": ["(source (transform))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "text"}, {"name": "t0"}],
+             "parameters": {"data_sources": ["hello pipeline world"],
+                            "count": measure + 60, "timestamps": True},
+             "deploy": _local("TextSource")},
+            {"name": "transform", "input": [{"name": "text"}],
+             "output": [{"name": "text"}],
+             "parameters": {"transform": "upper"},
+             "deploy": _local("TextTransform")},
+        ],
+    }
+    fps, p50, _ = _run_pipeline(definition, warmup=50, measure=measure,
+                                ready_key="text")
+    return {"frames_per_sec": round(fps, 1),
+            "p50_ms": round(p50 * 1000, 3),
+            "vs_reference_broker_ceiling": round(
+                fps / REFERENCE_FRAMES_PER_SEC, 1)}
+
+
+# -- config 2: ASR -----------------------------------------------------------
+
+def bench_asr(peak):
+    from aiko_services_tpu.models import asr_flops_per_example
+    from aiko_services_tpu.models.configs import (
+        WHISPER_SMALL, WHISPER_TINY)
+    config = WHISPER_TINY if SMOKE else WHISPER_SMALL
+    preset = "whisper_tiny" if SMOKE else "whisper_small"
+    batch = 2 if SMOKE else 4
+    seconds = 1.0 if SMOKE else 5.0
+    max_tokens = 8 if SMOKE else 32
+    warmup, measure = (2, 4) if SMOKE else (5, 40)
+    definition = {
+        "name": "bench_asr",
+        "graph": ["(tone (asr))"],
+        "elements": [
+            {"name": "tone", "output": [{"name": "audio"}, {"name": "t0"}],
+             "parameters": {"data_sources": [[440, seconds]],
+                            "data_batch_size": batch, "timestamps": True,
+                            "count": warmup + measure + 4},
+             "deploy": _local("ToneSource")},
+            {"name": "asr", "input": [{"name": "audio"}],
+             "output": [{"name": "tokens"}],
+             "parameters": {"preset": preset, "max_tokens": max_tokens,
+                            "dtype": ("float32" if SMOKE
+                                      else "bfloat16")},
+             "deploy": _local("SpeechToText")},
+        ],
+    }
+    fps, p50, _ = _run_pipeline(definition, warmup=warmup, measure=measure,
+                                ready_key="tokens")
+    n_frames = int(seconds * 100) // 2  # mel 10 ms hop, conv /2
+    flops = asr_flops_per_example(config, n_frames, max_tokens) * batch
+    return {"frames_per_sec_chip": round(fps, 2),
+            "audio_sec_per_sec": round(fps * batch * seconds, 1),
+            "p50_ms": round(p50 * 1000, 2),
+            "model": preset,
+            "batch": batch,
+            "mfu": _mfu(fps * flops, peak)}
+
+
+# -- config 3: detector ------------------------------------------------------
+
+def bench_detector(peak):
+    from aiko_services_tpu.models import detector_flops_per_image
+    from aiko_services_tpu.models.configs import (
+        DETECTOR_TOY, YOLOV8N_SHAPE)
+    config = DETECTOR_TOY if SMOKE else YOLOV8N_SHAPE
+    preset = "toy" if SMOKE else "yolov8n"
+    batch = 2 if SMOKE else 8
+    warmup, measure = (2, 6) if SMOKE else (10, 100)
+    size = config.image_size
+    definition = {
+        "name": "bench_det",
+        "graph": ["(camera (detector))"],
+        "elements": [
+            {"name": "camera", "output": [{"name": "image"}, {"name": "t0"}],
+             "parameters": {"data_sources": [[batch, 3, size, size]],
+                            "timestamps": True,
+                            "count": warmup + measure + 4},
+             "deploy": _local("ImageSource")},
+            {"name": "detector", "input": [{"name": "image"}],
+             "output": [{"name": "detections"}],
+             "parameters": {"preset": preset,
+                            "dtype": ("float32" if SMOKE
+                                      else "bfloat16")},
+             "deploy": _local("Detector")},
+        ],
+    }
+    fps, p50, _ = _run_pipeline(definition, warmup=warmup, measure=measure,
+                                ready_key="detections")
+    flops = detector_flops_per_image(config) * batch
+    return {"frames_per_sec_chip": round(fps, 2),
+            "images_per_sec": round(fps * batch, 1),
+            "p50_ms": round(p50 * 1000, 2),
+            "model": f"{preset} {size}x{size}",
+            "batch": batch,
+            "mfu": _mfu(fps * flops, peak)}
+
+
+# -- config 4: LLM decode ----------------------------------------------------
+
+def bench_llm(peak):
+    import jax
+    import jax.numpy as jnp
+
+    from aiko_services_tpu.models import (
+        count_params, generate_stream, init_params,
+        transformer_flops_per_token)
+    from aiko_services_tpu.models.configs import LLAMA32_1B, LM_TOY
+
+    config = LM_TOY if SMOKE else LLAMA32_1B
+    name = "lm_toy" if SMOKE else "llama32_1b"
+    prompt_len = 32 if SMOKE else 128
+    max_new = 16 if SMOKE else 128
+    batch = 1 if SMOKE else 4
+    params = init_params(config, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    prompt = jnp.ones((batch, prompt_len), jnp.int32)
+
+    # warmup compiles prefill + decode chunks at the MEASURED cache shape
+    # (cache max_len is a compile-time shape: warming with a different
+    # max_new would leave the real compile inside the TTFT measurement)
+    chunk = 8 if SMOKE else 32
+    for _ in generate_stream(params, config, prompt, max_new, chunk=chunk):
+        pass
+
+    start = time.perf_counter()
+    ttft = None
+    produced = 0
+    for offset, block in generate_stream(params, config, prompt, max_new,
+                                         chunk=chunk):
+        if ttft is None:
+            ttft = time.perf_counter() - start
+        produced += block.shape[1]
+    elapsed = time.perf_counter() - start
+    tokens_per_sec = produced * batch / elapsed
+    decode_flops = transformer_flops_per_token(config, prompt_len)
+    return {"model": f"{name} ({n_params / 1e6:.0f}M params)",
+            "batch": batch,
+            "prompt_len": prompt_len,
+            "time_to_first_token_ms": round(ttft * 1000, 1),
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "decode_mfu": _mfu(tokens_per_sec * decode_flops, peak)}
+
+
+# -- config 5: 3-stage multi-modal pipeline ---------------------------------
+
+def bench_multimodal(peak):
+    from aiko_services_tpu.models import (
+        asr_flops_per_example, detector_flops_per_image,
+        transformer_flops_per_token)
+    from aiko_services_tpu.models.configs import WHISPER_TINY
+    from aiko_services_tpu.models.detector import DetectorConfig
+    from aiko_services_tpu.models.transformer import TransformerConfig
+
+    warmup, measure = (2, 8) if SMOKE else (10, 120)
+    audio_seconds = 1.0
+    image_size = 64 if SMOKE else 256
+    lm = dict(vocab_size=1024, d_model=256 if SMOKE else 512,
+              n_layers=2 if SMOKE else 8, n_heads=8, n_kv_heads=4,
+              d_ff=768 if SMOKE else 1536, max_seq_len=2048,
+              dtype="float32" if SMOKE else "bfloat16")
+    asr = dict(d_model=WHISPER_TINY.d_model if not SMOKE else 64,
+               enc_layers=4 if not SMOKE else 1,
+               dec_layers=4 if not SMOKE else 1,
+               n_heads=6 if not SMOKE else 2, vocab_size=1024,
+               max_tokens=16, max_frames=1500,
+               dtype="float32" if SMOKE else "bfloat16")
+    det = dict(n_classes=16, base_channels=8 if SMOKE else 32,
+               image_size=image_size,
+               dtype="float32" if SMOKE else "bfloat16")
+    definition = {
+        "name": "bench_multimodal",
+        "graph": ["(sources (asr (text) (lm)) (detector))"],
+        "elements": [
+            {"name": "sources",
+             "output": [{"name": "audio"}, {"name": "image"},
+                        {"name": "t0"}],
+             "parameters": {"data_sources": [[440, audio_seconds]],
+                            "image_shape": [3, image_size, image_size],
+                            "timestamps": True,
+                            "count": warmup + measure + 4},
+             "deploy": _local("MultiModalSource")},
+            {"name": "asr", "input": [{"name": "audio"}],
+             "output": [{"name": "tokens"}],
+             "parameters": asr, "deploy": _local("SpeechToText")},
+            {"name": "text", "input": [{"name": "tokens"}],
+             "output": [{"name": "text"}],
+             "deploy": _local("TokensToText")},
+            {"name": "lm", "input": [{"name": "tokens"}],
+             "output": [{"name": "logits"}, {"name": "nll"}],
+             "parameters": lm, "deploy": _local("LMForward")},
+            {"name": "detector", "input": [{"name": "image"}],
+             "output": [{"name": "detections"}],
+             "parameters": det, "deploy": _local("Detector")},
+        ],
+    }
+    fps, p50, _ = _run_pipeline(definition, warmup=warmup, measure=measure,
+                                ready_key="detections")
+    # per-frame compute across the three model stages
+    from aiko_services_tpu.models.asr import AsrConfig
+    asr_config = AsrConfig(**{k: v for k, v in asr.items()
+                              if k not in ("max_tokens",)})
+    lm_config = TransformerConfig(**lm)
+    det_config = DetectorConfig(**det)
+    n_frames = int(audio_seconds * 100) // 2
+    lm_tokens = asr["max_tokens"]
+    flops = (asr_flops_per_example(asr_config, n_frames, lm_tokens)
+             + transformer_flops_per_token(lm_config, lm_tokens) * lm_tokens
+             + detector_flops_per_image(det_config))
+    return {"frames_per_sec_chip": round(fps, 2),
+            "p50_ms": round(p50 * 1000, 2),
+            "stages": "speech->(text,lm) + vision->detections",
+            "mfu": _mfu(fps * flops, peak)}, fps, p50
+
+
+def main() -> None:
+    platform = os.environ.get("AIKO_BENCH_PLATFORM")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+    import jax
+
+    peak = _peak_flops_per_chip()
+    wanted = os.environ.get(
+        "AIKO_BENCH_CONFIGS", "text,asr,detector,llm,pipeline").split(",")
+    configs = {}
+    if "text" in wanted:
+        configs["text"] = bench_text()
+    if "asr" in wanted:
+        configs["asr"] = bench_asr(peak)
+    if "detector" in wanted:
+        configs["detector"] = bench_detector(peak)
+    if "llm" in wanted:
+        configs["llm"] = bench_llm(peak)
+    headline_fps, headline_p50 = None, None
+    if "pipeline" in wanted:
+        configs["pipeline_multimodal"], headline_fps, headline_p50 = (
+            bench_multimodal(peak))
+    if headline_fps is None:  # subset run: headline from first config
+        first = next(iter(configs.values()))
+        headline_fps = (first.get("frames_per_sec_chip")
+                        or first.get("frames_per_sec")
+                        or first.get("tokens_per_sec", 0.0))
+        headline_p50 = first.get("p50_ms", 0.0) / 1000.0
+
     result = {
-        "metric": "lm_pipeline_frames_per_sec",
-        "value": round(frames_per_sec, 2),
-        "unit": (f"frames/sec (batch={BATCH} seq={SEQ_LEN} "
-                 f"d{D_MODEL}x{N_LAYERS}L transformer fwd, HBM-resident)"),
-        "vs_baseline": round(frames_per_sec / REFERENCE_FRAMES_PER_SEC, 2),
-        "p50_frame_latency_ms": round(
-            float(np.percentile(latencies, 50) * 1000), 2),
-        "tokens_per_sec": round(frames_per_sec * BATCH * SEQ_LEN, 0),
+        "metric": "multimodal_pipeline_frames_per_sec",
+        "value": round(headline_fps, 2),
+        "unit": ("frames/sec end-to-end (3-stage speech+LM+vision graph, "
+                 "HBM-resident, 1 chip)"),
+        "vs_baseline": round(headline_fps / REFERENCE_FRAMES_PER_SEC, 2),
+        "p50_frame_latency_ms": round(headline_p50 * 1000, 2),
+        "device": jax.devices()[0].device_kind,
+        "peak_tflops_assumed": (round(peak / 1e12, 1) if peak else None),
+        "smoke": SMOKE,
+        "configs": configs,
     }
     print(json.dumps(result))
 

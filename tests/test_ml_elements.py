@@ -190,6 +190,86 @@ def test_tokens_to_text_out_of_range_ids():
     # ADVICE round 1: ids >= 259 must be skipped, not crash bytes()
     from aiko_services_tpu.elements.ml import TokensToText
     element = TokensToText.__new__(TokensToText)
+    element.get_parameter = lambda name, default=None, stream=None: default
     tokens = np.array([[0, 1, 2, 3 + ord("h"), 3 + ord("i"), 300, 1023]])
     _, outputs = element.process_frame(None, tokens)
     assert outputs["text"] == ["hi"]
+
+
+def test_text_to_tokens_to_lm_with_tokenizer_streaming():
+    # real-text path: TextToTokens (BPE asset) -> LMGenerate with streamed
+    # token chunks published to /out, decoded text in the response
+    definition = {
+        "name": "chat_pipe",
+        "graph": ["(prompt (lm))"],
+        "elements": [
+            {"name": "prompt", "input": [{"name": "text"}],
+             "output": [{"name": "tokens"}],
+             "deploy": local("TextToTokens")},
+            {"name": "lm", "input": [{"name": "tokens"}],
+             "output": [{"name": "generated"}, {"name": "text"}],
+             "parameters": {**TINY_LM, "vocab_size": 4096,
+                            "tokenizer": "default", "max_new_tokens": 6,
+                            "stream_tokens": True, "stream_chunk": 2},
+             "deploy": local("LMGenerate")},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    streamed = []
+    process.add_message_handler(
+        lambda topic, payload: streamed.append(payload),
+        f"{pipeline.elements['lm'].topic_path}/out")
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s", queue_response=responses, grace_time=300)
+    pipeline.process_frame({"stream_id": "s"}, {"text": "hello pipeline"})
+    _, _, outputs = responses.get(timeout=120)
+    assert np.asarray(outputs["generated"]).shape == (1, 6)
+    assert isinstance(outputs["text"], list)
+    # 6 tokens in chunks of 2 -> 3 streamed publishes
+    from helpers import wait_for
+    wait_for(lambda: len([s for s in streamed if "tokens" in s]) >= 3)
+    process.terminate()
+
+
+def test_lm_generate_weights_parameter(tmp_path):
+    # seeded random params saved to safetensors load back identically
+    import jax
+    from aiko_services_tpu.models import (
+        TransformerConfig, generate, init_params, save_pytree)
+    config = TransformerConfig(
+        vocab_size=300, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+        d_ff=64, max_seq_len=2048, dtype="float32")
+    params = init_params(config, jax.random.PRNGKey(0))
+    path = tmp_path / "lm.safetensors"
+    save_pytree(path, params)
+
+    definition = {
+        "name": "wpipe",
+        "graph": ["(lm)"],
+        "elements": [
+            {"name": "lm", "input": [{"name": "tokens"}],
+             "output": [{"name": "generated"}],
+             "parameters": {**TINY_LM, "weights": str(path),
+                            "max_new_tokens": 4},
+             "deploy": local("LMGenerate")},
+        ],
+    }
+    prompt = np.array([[7, 8, 9]], np.int32)
+    [(_, _, outputs)] = run_frames_with_data(definition, {"tokens": prompt})
+    expected, _ = generate(params, config, prompt, 4)
+    np.testing.assert_array_equal(np.asarray(outputs["generated"]),
+                                  np.asarray(expected))
+
+
+def run_frames_with_data(definition, frame_data, timeout=120):
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s", queue_response=responses, grace_time=300)
+    pipeline.process_frame({"stream_id": "s"}, frame_data)
+    results = [responses.get(timeout=timeout)]
+    process.terminate()
+    return results

@@ -86,3 +86,96 @@ class TestSequenceParallel:
         expected = attention_reference(q, k, v, causal=True)
         actual = ulysses_attention(q, k, v, mesh, causal=True)
         np.testing.assert_allclose(actual, expected, atol=2e-3, rtol=2e-3)
+
+
+class TestFlashBackward:
+    """Pallas backward kernels (dq; dk/dv) vs jax.grad of the XLA oracle
+    (VERDICT round 1 item 3)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grad_parity(self, causal):
+        q, k, v = _qkv(seq=96)
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, causal=causal, block_q=32,
+                                  block_k=32)
+            return jnp.sum(out * jnp.cos(out.astype(jnp.float32)))
+
+        def loss_ref(q, k, v):
+            out = attention_reference(q, k, v, causal=causal)
+            return jnp.sum(out * jnp.cos(out.astype(jnp.float32)))
+
+        grads_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        grads_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for actual, expected, name in zip(grads_flash, grads_ref,
+                                          ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(actual), np.asarray(expected),
+                atol=5e-3, rtol=5e-3, err_msg=name)
+
+    def test_grad_parity_ragged_and_cross(self):
+        # q/k lengths differ and are not block multiples
+        q, _, _ = _qkv(seq=50)
+        _, k, v = _qkv(seq=70, seed=3)
+
+        def loss(fn):
+            def inner(q, k, v):
+                return jnp.sum(fn(q, k, v) ** 2)
+            return inner
+
+        flash = loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16))
+        ref = loss(lambda q, k, v: attention_reference(q, k, v,
+                                                       causal=True))
+        got = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for actual, expected in zip(got, want):
+            np.testing.assert_allclose(np.asarray(actual),
+                                       np.asarray(expected),
+                                       atol=5e-3, rtol=5e-3)
+
+    def test_grad_parity_seq_4k(self):
+        # the VERDICT done-criterion sequence length, batch/head-reduced
+        q, k, v = _qkv(batch=1, heads=1, seq=4096, dim=16, seed=7)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        got = jax.grad(loss_flash)(q, k, v)
+        want = jax.grad(loss_ref)(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_backward_memory_is_blockwise(self):
+        # the jaxpr of the flash grad must contain no (L, L) intermediate:
+        # residuals are q/k/v/o (L, D) + lse (L,) -- O(L x block) peak
+        seq = 1024
+        q, k, v = _qkv(batch=1, heads=1, seq=seq, dim=16, seed=1)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+        jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+        def max_intermediate(jaxpr):
+            worst = 0
+            for eqn in jaxpr.eqns:
+                for var in eqn.outvars:
+                    shape = getattr(var.aval, "shape", ())
+                    size = 1
+                    for dim in shape:
+                        size *= dim
+                    worst = max(worst, size)
+                for sub in eqn.params.values():
+                    if hasattr(sub, "jaxpr"):
+                        worst = max(worst, max_intermediate(sub.jaxpr))
+            return worst
+
+        worst = max_intermediate(jaxpr.jaxpr)
+        # seq*seq would be 1M elements; blockwise peak is O(seq x 128)
+        assert worst < seq * seq, (
+            f"O(L^2) intermediate found: {worst} elements")
+        assert worst <= seq * 256

@@ -1,0 +1,231 @@
+# Weight ingestion + tokenizer: safetensors round-trip (incl. bf16), HF
+# Llama naming -> framework pytree parity, BPE train/encode/decode
+# round-trips, HF tokenizer.json loading, and the streamed decode path.
+
+import json
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models import (
+    BPETokenizer, TransformerConfig, forward, generate, generate_stream,
+    init_params, load_llama_params, load_pytree, read_safetensors,
+    save_pytree, train_bpe, write_safetensors)
+from aiko_services_tpu.models.configs import (
+    LLAMA3_8B, WHISPER_SMALL, YOLOV8N_SHAPE, transformer_flops_per_token)
+
+
+# -- safetensors container ---------------------------------------------------
+
+def test_safetensors_roundtrip(tmp_path):
+    tensors = {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b.c": np.ones((2, 2), dtype=ml_dtypes.bfloat16),
+        "ints": np.array([1, 2, 3], dtype=np.int64),
+    }
+    path = tmp_path / "t.safetensors"
+    write_safetensors(path, tensors, metadata={"format": "pt"})
+    loaded = read_safetensors(path)
+    assert set(loaded) == set(tensors)
+    for name in tensors:
+        assert loaded[name].dtype == tensors[name].dtype
+        np.testing.assert_array_equal(
+            np.asarray(loaded[name], np.float64),
+            np.asarray(tensors[name], np.float64))
+
+
+def test_pytree_roundtrip(tmp_path):
+    tree = {"layer": {"w": np.ones((2, 3), np.float32),
+                      "b": np.zeros((3,), np.float32)},
+            "top": np.full((4,), 2.0, np.float32)}
+    path = tmp_path / "p.safetensors"
+    save_pytree(path, tree)
+    back = load_pytree(path)
+    assert back["layer"]["w"].shape == (2, 3)
+    assert back["top"][0] == 2.0
+    cast = load_pytree(path, dtype="bfloat16")
+    assert cast["layer"]["w"].dtype == ml_dtypes.bfloat16
+
+
+def _tiny_config():
+    return TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=32, max_seq_len=32, dtype="float32")
+
+
+def _write_hf_llama(path, config, seed=0, lm_head=False):
+    """Fake HF-named checkpoint with HF (out, in) weight layout."""
+    rng = np.random.default_rng(seed)
+    hd = config.head_dim
+    tensors = {
+        "model.embed_tokens.weight": rng.standard_normal(
+            (config.vocab_size, config.d_model)).astype(np.float32),
+        "model.norm.weight": np.ones((config.d_model,), np.float32),
+    }
+    if lm_head:
+        tensors["lm_head.weight"] = rng.standard_normal(
+            (config.vocab_size, config.d_model)).astype(np.float32)
+    for layer in range(config.n_layers):
+        prefix = f"model.layers.{layer}."
+        tensors.update({
+            prefix + "input_layernorm.weight":
+                np.ones((config.d_model,), np.float32),
+            prefix + "post_attention_layernorm.weight":
+                np.ones((config.d_model,), np.float32),
+            prefix + "self_attn.q_proj.weight": rng.standard_normal(
+                (config.n_heads * hd, config.d_model)).astype(np.float32),
+            prefix + "self_attn.k_proj.weight": rng.standard_normal(
+                (config.n_kv_heads * hd,
+                 config.d_model)).astype(np.float32),
+            prefix + "self_attn.v_proj.weight": rng.standard_normal(
+                (config.n_kv_heads * hd,
+                 config.d_model)).astype(np.float32),
+            prefix + "self_attn.o_proj.weight": rng.standard_normal(
+                (config.d_model, config.n_heads * hd)).astype(np.float32),
+            prefix + "mlp.gate_proj.weight": rng.standard_normal(
+                (config.d_ff, config.d_model)).astype(np.float32),
+            prefix + "mlp.up_proj.weight": rng.standard_normal(
+                (config.d_ff, config.d_model)).astype(np.float32),
+            prefix + "mlp.down_proj.weight": rng.standard_normal(
+                (config.d_model, config.d_ff)).astype(np.float32),
+        })
+    write_safetensors(path, tensors)
+    return tensors
+
+
+def test_load_llama_params_shapes_and_orientation(tmp_path):
+    config = _tiny_config()
+    path = tmp_path / "model.safetensors"
+    tensors = _write_hf_llama(path, config)
+    params = load_llama_params(path, config)
+    hd = config.head_dim
+    assert params["embed"]["w"].shape == (config.vocab_size, config.d_model)
+    assert params["layers"]["wq"]["w"].shape == (
+        config.n_layers, config.d_model, config.n_heads * hd)
+    # orientation: our wq.w must be the transpose of HF q_proj
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["wq"]["w"][0]),
+        tensors["model.layers.0.self_attn.q_proj.weight"].T, rtol=1e-6)
+    # loaded params run end-to-end
+    logits = forward(params, config, jnp.ones((1, 4), jnp.int32))
+    assert logits.shape == (1, 4, config.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_load_llama_untied_head_changes_logits(tmp_path):
+    config = _tiny_config()
+    tied = tmp_path / "tied.safetensors"
+    untied = tmp_path / "untied.safetensors"
+    _write_hf_llama(tied, config, seed=1)
+    _write_hf_llama(untied, config, seed=1, lm_head=True)
+    params_tied = load_llama_params(tied, config)
+    params_untied = load_llama_params(untied, config)
+    assert "lm_head" in params_untied and "lm_head" not in params_tied
+    tokens = jnp.ones((1, 4), jnp.int32)
+    a = forward(params_tied, config, tokens)
+    b = forward(params_untied, config, tokens)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_load_llama_sharded_on_mesh(tmp_path):
+    from aiko_services_tpu.models import param_specs
+    from aiko_services_tpu.parallel.mesh import create_mesh
+    config = _tiny_config()
+    path = tmp_path / "model.safetensors"
+    _write_hf_llama(path, config)
+    mesh = create_mesh({"data": 2, "fsdp": 1, "seq": 2, "model": 2})
+    params = load_llama_params(path, config, mesh=mesh,
+                               specs=param_specs(config))
+    wq = params["layers"]["wq"]["w"]
+    assert len(wq.sharding.device_set) == 8
+    with jax.set_mesh(mesh):
+        logits = forward(params, config, jnp.ones((2, 4), jnp.int32))
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_missing_tensor_raises(tmp_path):
+    config = _tiny_config()
+    path = tmp_path / "broken.safetensors"
+    tensors = _write_hf_llama(path, config)
+    del tensors["model.layers.1.mlp.up_proj.weight"]
+    write_safetensors(path, tensors)
+    with pytest.raises(KeyError, match="mlp.up_proj"):
+        load_llama_params(path, config)
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+def test_bpe_train_roundtrip():
+    corpus = ["the pipeline processes frames of tokens",
+              "frames flow through the pipeline elements"] * 10
+    tokenizer = train_bpe(corpus, vocab_size=300)
+    for text in ["the pipeline", "unseen wørds 123!", "  spaced  out  "]:
+        assert tokenizer.decode(tokenizer.encode(text)) == text
+    ids = tokenizer.encode("the pipeline", bos=True, eos=True)
+    assert ids[0] == tokenizer.bos_id and ids[-1] == tokenizer.eos_id
+
+
+def test_default_asset_loads_and_compresses():
+    tokenizer = BPETokenizer.default()
+    text = "The pipeline processes frames through elements."
+    ids = tokenizer.encode(text)
+    assert tokenizer.decode(ids) == text
+    assert len(ids) < len(text) / 2  # real merges, not bytes
+
+
+def test_hf_tokenizer_json_format(tmp_path):
+    base = train_bpe(["hello world hello there"], vocab_size=280)
+    hf = {
+        "model": {
+            "vocab": base.vocab,
+            "merges": [f"{a} {b}" for a, b in base.merges],
+        },
+        "added_tokens": [
+            {"id": 0, "content": "<|begin_of_text|>"},
+            {"id": 1, "content": "<|end_of_text|>"},
+        ],
+    }
+    path = tmp_path / "tokenizer.json"
+    path.write_text(json.dumps(hf))
+    tokenizer = BPETokenizer.from_file(path)
+    assert tokenizer.bos_id == 0 and tokenizer.eos_id == 1
+    assert tokenizer.decode(tokenizer.encode("hello world")) == (
+        "hello world")
+
+
+# -- presets + analytics -----------------------------------------------------
+
+def test_reference_scale_configs():
+    # Llama-3-8B ~8.0B params; Whisper-small ~240M (analytic counts)
+    def lm_params(c):
+        hd = c.head_dim
+        per_layer = (c.d_model * hd * (c.n_heads * 2 + c.n_kv_heads * 2)
+                     + 3 * c.d_model * c.d_ff + 2 * c.d_model)
+        return (c.vocab_size * c.d_model * 2   # embed + untied head
+                + c.n_layers * per_layer + c.d_model)
+    total = lm_params(LLAMA3_8B)
+    assert 7.5e9 < total < 8.6e9
+    assert WHISPER_SMALL.d_model == 768 and WHISPER_SMALL.enc_layers == 12
+    assert YOLOV8N_SHAPE.image_size == 640
+    assert YOLOV8N_SHAPE.n_classes == 80
+    flops = transformer_flops_per_token(LLAMA3_8B)
+    assert 1.3e10 < flops < 2.0e10  # ~2*7B matmul params
+
+
+# -- streamed decode ---------------------------------------------------------
+
+def test_generate_stream_matches_generate():
+    config = _tiny_config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = jnp.array([[5, 6, 7]], jnp.int32)
+    full, _ = generate(params, config, prompt, max_new_tokens=9)
+    chunks = list(generate_stream(params, config, prompt,
+                                  max_new_tokens=9, chunk=4))
+    # first token streams immediately after prefill (TTFT), then chunks
+    assert [offset for offset, _ in chunks] == [0, 1, 5]
+    assert [block.shape[1] for _, block in chunks] == [1, 4, 4]
+    streamed = np.concatenate([block for _, block in chunks], axis=1)
+    np.testing.assert_array_equal(np.asarray(full), streamed)
