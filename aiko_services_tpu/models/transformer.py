@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.attention import flash_attention, ring_attention
+from ..parallel.attention import (
+    flash_attention, ring_attention, sp_decode_attention)
 from .layers import (
     apply_rotary, dense, init_dense, init_norm, repeat_kv, rms_norm,
     rotary_embedding)
@@ -48,9 +49,13 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
     dtype: str = "bfloat16"
-    # True: prefill attention runs as ring attention over the mesh "seq"
-    # axis (shard_map + ppermute; requires an ambient jax.set_mesh whose
-    # seq axis divides the sequence length) -- the long-context path.
+    # True: the long-context path.  Prefill attention runs as ring
+    # attention over the mesh "seq" axis (shard_map + ppermute) and
+    # cached DECODE runs sp_decode_attention with the cache length
+    # sharded over "seq" -- lay the cache out with
+    # cache_specs(sequence_parallel=True).  Requires an ambient
+    # jax.set_mesh holding a "seq" axis that divides the sequence length
+    # (prefill) and cache length (decode); cached prefill assumes pos=0.
     sequence_parallel: bool = False
     # > 0: the FFN becomes a switch (top-1) mixture of experts with this
     # many experts; expert weights shard over the mesh "expert" axis
@@ -162,9 +167,14 @@ def init_cache(config: TransformerConfig, batch: int,
             "v": jnp.zeros(shape, config.jnp_dtype)}
 
 
-def cache_specs() -> dict:
-    return {"k": P(None, "data", "model", None, None),
-            "v": P(None, "data", "model", None, None)}
+def cache_specs(sequence_parallel: bool = False) -> dict:
+    """Cache layout (layers, batch, kv_heads, len, head_dim): batch on
+    "data", heads on "model" (TP); with sequence_parallel the cache LENGTH
+    also shards over "seq", so long-context decode spreads KV bandwidth
+    across the mesh (sp_decode_attention)."""
+    seq = "seq" if sequence_parallel else None
+    spec = P(None, "data", "model", seq, None)
+    return {"k": spec, "v": spec}
 
 
 # -- forward ----------------------------------------------------------------
@@ -196,18 +206,31 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
     else:
         cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
-        k_full = repeat_kv(cache_k, repeats)
-        v_full = repeat_kv(cache_v, repeats)
-        scale = 1.0 / jnp.sqrt(jnp.float32(hd))
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_full,
-                            preferred_element_type=jnp.float32) * scale
-        max_len = cache_k.shape[2]
-        q_pos = pos + jnp.arange(length)[:, None]
-        k_pos = jnp.arange(max_len)[None, :]
-        logits = jnp.where(k_pos <= q_pos, logits, -1e30)
-        weights = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v_full.dtype),
-                         v_full)
+        if config.sequence_parallel and length > 1:
+            # cached PREFILL (pos must be 0, the generate/prefill
+            # contract): causal ring attention over the fresh K/V --
+            # never an O(Lq x Lc) logit tensor
+            out = ring_attention(q, repeat_kv(k, repeats),
+                                 repeat_kv(v, repeats), causal=True)
+        elif config.sequence_parallel:
+            # long-context decode: cache length sharded over the mesh
+            # "seq" axis; per-device attention touches only the local
+            # cache shard (GQA heads expand inside the shard), partials
+            # merge with a pmax/psum online-softmax
+            out = sp_decode_attention(q, cache_k, cache_v, pos)
+        else:
+            k_full = repeat_kv(cache_k, repeats)
+            v_full = repeat_kv(cache_v, repeats)
+            scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_full,
+                                preferred_element_type=jnp.float32) * scale
+            max_len = cache_k.shape[2]
+            q_pos = pos + jnp.arange(length)[:, None]
+            k_pos = jnp.arange(max_len)[None, :]
+            logits = jnp.where(k_pos <= q_pos, logits, -1e30)
+            weights = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd",
+                             weights.astype(v_full.dtype), v_full)
     out = out.transpose(0, 2, 1, 3).reshape(batch, length, -1)
     return dense(layer["wo"], out), cache_k, cache_v
 

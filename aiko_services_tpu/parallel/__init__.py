@@ -3,4 +3,6 @@ from .mesh import (                                           # noqa: F401
     shard_pytree, filter_specs)
 from .attention import (                                      # noqa: F401
     attention_reference, flash_attention, ring_attention,
-    ring_attention_sharded, ulysses_attention, ulysses_attention_sharded)
+    ring_attention_sharded, sp_decode_attention,
+    sp_decode_attention_sharded, ulysses_attention,
+    ulysses_attention_sharded)

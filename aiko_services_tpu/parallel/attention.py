@@ -33,7 +33,7 @@ from .mesh import create_mesh  # noqa: F401  (re-exported convenience)
 
 __all__ = [
     "attention_reference", "flash_attention", "ring_attention",
-    "ulysses_attention",
+    "sp_decode_attention", "ulysses_attention",
 ]
 
 _NEG_INF = -1e30
@@ -468,64 +468,157 @@ def _flash_bwd_impl(q, k, v, out, lse, dout, causal, sm_scale, block_q,
 
 # -- Ring attention (sequence parallel) -------------------------------------
 
-def _block_attention_stats(q, k, v, sm_scale, mask):
-    """One blockwise partial-attention step returning (m, l, acc) online-
-    softmax statistics so partial results merge associatively."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * sm_scale
-    s = jnp.where(mask, s, _NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)
-    return m, l, acc
+# Test hook: when set to a callable, it is invoked (via jax.debug.callback)
+# once per EXECUTED ring hop -- hops skipped by the causal lax.cond branch
+# never fire it.  Tests use this to assert the masked-hop skip is real.
+_RING_HOP_CALLBACK = None
+
+
+def _merge_softmax_partials(out, lse, out_blk, lse_blk):
+    """Associative merge of two normalized attention partials via their
+    per-row logsumexp: exact online-softmax combination."""
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    w_old = jnp.exp(lse - lse_new)[..., None]
+    w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+    merged = (out.astype(jnp.float32) * w_old
+              + out_blk.astype(jnp.float32) * w_blk)
+    return merged.astype(out.dtype), lse_new
 
 
 def ring_attention_sharded(q, k, v, axis_name: str = "seq",
-                           causal: bool = True, sm_scale=None):
+                           causal: bool = True, sm_scale=None,
+                           block_q: int = 128, block_k: int = 128):
     """Sequence-parallel attention over mesh axis `axis_name`; call INSIDE
     shard_map with q/k/v seq-sharded as (B, H, L/n, D).
 
     Q stays resident; K/V shards rotate n-1 hops around the ring via
     ppermute (XLA lowers to ICI collective-permute, overlapping each hop
-    with the current block's MXU work).  Per-hop partials merge with the
-    associative online-softmax update, so the result is exact.
+    with the current block's MXU work).  Each hop runs the Pallas flash
+    kernel (O(block) VMEM, never a materialized (L/n)^2 logit tensor) and
+    returns (out, lse); hops merge with the associative online-softmax
+    combination, so the result is exact.
+
+    Under causal masking the ring ordering sends device i the K/V shard of
+    device (i - step) mod n at hop `step`; that shard is entirely in the
+    future (fully masked) exactly when step > i, so those hops are skipped
+    with lax.cond -- no flash call, no wasted MXU work.  Device i executes
+    i + 1 of the n hops; total executed hops are n(n+1)/2 instead of n^2.
+
+    Differentiable: the custom VJP runs a second ring in which dk/dv
+    accumulators travel WITH their K/V shards; each executed hop runs the
+    blockwise Pallas backward kernels against the forward's GLOBAL
+    logsumexp, so backward peak memory stays O(L/n x block) per device.
     """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    local_len = q.shape[2]
+    return _ring(q, k, v, bool(causal), float(sm_scale), str(axis_name),
+                 int(min(block_q, local_len)), int(min(block_k, local_len)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, causal, sm_scale, axis_name, block_q, block_k):
+    out, _ = _ring_fwd_impl(q, k, v, causal, sm_scale, axis_name, block_q,
+                            block_k)
+    return out
+
+
+def _ring_fwd_impl(q, k, v, causal, sm_scale, axis_name, block_q, block_k):
     axis_size = jax.lax.axis_size(axis_name)
     my_index = jax.lax.axis_index(axis_name)
-    batch, heads, local_len, head_dim = q.shape
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(head_dim)
-
-    q_f32 = q.astype(jnp.float32)
-    q_pos = (my_index * local_len
-             + jnp.arange(local_len)[None, None, :, None])
-
-    m = jnp.full((batch, heads, local_len, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((batch, heads, local_len, 1), jnp.float32)
-    acc = jnp.zeros((batch, heads, local_len, head_dim), jnp.float32)
+    batch, heads, local_len, _ = q.shape
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
+    def compute_hop(k_blk, v_blk, step):
+        # step 0 is the diagonal block (standard causal); every executed
+        # later hop holds strictly-past keys, so it runs dense non-causal.
+        out_blk, lse_blk = _flash_impl(
+            q, k_blk, v_blk, causal and step == 0, sm_scale, block_q,
+            block_k, 0)
+        if _RING_HOP_CALLBACK is not None:
+            jax.debug.callback(_RING_HOP_CALLBACK, step)
+        return out_blk, lse_blk
+
+    def skipped_hop(k_blk, v_blk, step):
+        return (jnp.zeros_like(q),
+                jnp.full((batch, heads, local_len), _NEG_INF, jnp.float32))
+
+    out, lse = compute_hop(k, v, 0)
+    out = out.astype(jnp.float32)
     k_blk, v_blk = k, v
+    for step in range(1, axis_size):
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        if causal:
+            # src shard = (my_index - step) mod n; fully masked iff it
+            # wrapped, i.e. my_index < step
+            out_blk, lse_blk = jax.lax.cond(
+                my_index >= step,
+                functools.partial(compute_hop, step=step),
+                functools.partial(skipped_hop, step=step),
+                k_blk, v_blk)
+        else:
+            out_blk, lse_blk = compute_hop(k_blk, v_blk, step)
+        out, lse = _merge_softmax_partials(out, lse, out_blk, lse_blk)
+    return out.astype(q.dtype), lse
+
+
+def _ring_fwd(q, k, v, causal, sm_scale, axis_name, block_q, block_k):
+    out, lse = _ring_fwd_impl(q, k, v, causal, sm_scale, axis_name,
+                              block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(causal, sm_scale, axis_name, block_q, block_k, residuals,
+              dout):
+    """Ring backward: a second rotation in which each K/V shard travels
+    with its dk/dv accumulator.  Every executed hop recomputes p blockwise
+    inside the Pallas backward kernels from the forward's global lse (so
+    per-hop partial gradients are exactly the global-attention gradients
+    restricted to that shard); a final ppermute delivers each dk/dv
+    accumulator back to its home device."""
+    q, k, v, out, lse = residuals
+    dq_acc = jnp.zeros(q.shape, jnp.float32)
+
+    def compute_hop(k_blk, v_blk, dk_blk, dv_blk, step):
+        dq_h, dk_h, dv_h = _flash_bwd_impl(
+            q, k_blk, v_blk, out, lse, dout, causal and step == 0,
+            sm_scale, block_q, block_k, 0)
+        return (dq_h.astype(jnp.float32), dk_blk + dk_h.astype(jnp.float32),
+                dv_blk + dv_h.astype(jnp.float32))
+
+    def skipped_hop(k_blk, v_blk, dk_blk, dv_blk, step):
+        return jnp.zeros(q.shape, jnp.float32), dk_blk, dv_blk
+
+    axis_size = jax.lax.axis_size(axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    carry = dq_acc
+    kv = (k, v, jnp.zeros(k.shape, jnp.float32),
+          jnp.zeros(v.shape, jnp.float32))
     for step in range(axis_size):
-        src_index = (my_index - step) % axis_size
-        k_pos = (src_index * local_len
-                 + jnp.arange(local_len)[None, None, None, :])
-        mask = (k_pos <= q_pos) if causal else jnp.ones(
-            (batch, heads, local_len, local_len), bool)
-        m_blk, l_blk, acc_blk = _block_attention_stats(
-            q_f32, k_blk.astype(jnp.float32), v_blk, sm_scale, mask)
-        m_new = jnp.maximum(m, m_blk)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(m_blk - m_new)
-        l = l * alpha + l_blk * beta
-        acc = acc * alpha + acc_blk * beta
-        m = m_new
-        if step + 1 < axis_size:
-            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+        if step > 0:
+            kv = tuple(jax.lax.ppermute(x, axis_name, perm) for x in kv)
+        if causal and step > 0:
+            hop_out = jax.lax.cond(
+                my_index >= step,
+                functools.partial(compute_hop, step=step),
+                functools.partial(skipped_hop, step=step),
+                *kv)
+        else:
+            hop_out = compute_hop(*kv, step=step)
+        dq_h, dk_blk, dv_blk = hop_out
+        carry = carry + dq_h
+        kv = (kv[0], kv[1], dk_blk, dv_blk)
+    # shard s sits on device (s + n - 1) mod n after the loop; one more
+    # rotation returns every dk/dv accumulator to its home device
+    dk = jax.lax.ppermute(kv[2], axis_name, perm)
+    dv = jax.lax.ppermute(kv[3], axis_name, perm)
+    return (carry.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_ring.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_attention(q, k, v, mesh=None, axis_name: str = "seq",
@@ -540,7 +633,75 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = "seq",
     kwargs = {} if mesh is None else {"mesh": mesh}
     return jax.shard_map(
         fn, in_specs=(spec, spec, spec), out_specs=spec,
-        **kwargs)(q, k, v)
+        check_vma=False, **kwargs)(q, k, v)
+
+
+# -- Sequence-parallel decode attention --------------------------------------
+
+def sp_decode_attention_sharded(q, cache_k, cache_v, pos,
+                                axis_name: str = "seq", sm_scale=None):
+    """Sequence-parallel KV-cached decode: call INSIDE shard_map with the
+    cache length axis sharded as (B, Hkv, Lc/n, D) and q (B, H, Lq, D)
+    replicated over the seq axis (Lq = 1 for single-token decode; Hkv may
+    be a divisor of H -- GQA heads expand on the LOCAL shard only).
+
+    Long-context *generation* with the cache spread over the mesh: each
+    device attends q over only its local cache shard (masked to positions
+    <= pos), producing a normalized partial + logsumexp; partials combine
+    exactly with a pmax/psum online-softmax merge over the axis, so
+    per-device attention bandwidth is O(Lc/n).  No ring needed -- q is
+    tiny, so an all-reduce of the (B, H, Lq, D) partial is cheap.
+    """
+    axis_index = jax.lax.axis_index(axis_name)
+    batch, kv_heads, local_len, head_dim = cache_k.shape
+    q_len, heads = q.shape[2], q.shape[1]
+    if heads != kv_heads:  # GQA: expand only the local Lc/n-sized shard
+        repeats = heads // kv_heads
+        cache_k = jnp.repeat(cache_k, repeats, axis=1)
+        cache_v = jnp.repeat(cache_v, repeats, axis=1)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+
+    k_pos = (axis_index * local_len
+             + jnp.arange(local_len))[None, None, None, :]
+    q_pos = (pos + jnp.arange(q_len))[None, None, :, None]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    m_local = jnp.max(s, axis=-1)                          # (B, H, Lq)
+    m_global = jax.lax.pmax(m_local, axis_name)
+    p = jnp.exp(s - m_global[..., None])
+    num = jnp.einsum("bhqk,bhkd->bhqd", p,
+                     cache_v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    den = jnp.sum(p, axis=-1, keepdims=True)               # (B, H, Lq, 1)
+    num = jax.lax.psum(num, axis_name)
+    den = jax.lax.psum(den, axis_name)
+    return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+
+
+def sp_decode_attention(q, cache_k, cache_v, pos, mesh=None,
+                        axis_name: str = "seq", sm_scale=None,
+                        batch_axis: str = "data", head_axis: str = "model"):
+    """shard_map entry point for sequence-parallel decode: cache length
+    sharded over `axis_name`, q sharded only on batch/head axes (when the
+    mesh has them -- composes with DP + TP), output sharded like q."""
+    if mesh is None:
+        axis_names = jax.sharding.get_abstract_mesh().axis_names
+    else:
+        axis_names = mesh.axis_names
+    b_ax = batch_axis if batch_axis in axis_names else None
+    h_ax = head_axis if head_axis in axis_names else None
+    q_spec = P(b_ax, h_ax, None, None)
+    cache_spec = P(b_ax, h_ax, axis_name, None)
+    fn = functools.partial(sp_decode_attention_sharded,
+                           axis_name=axis_name, sm_scale=sm_scale)
+    kwargs = {} if mesh is None else {"mesh": mesh}
+    return jax.shard_map(
+        fn,
+        in_specs=(q_spec, cache_spec, cache_spec, P()),
+        out_specs=q_spec,
+        check_vma=False, **kwargs)(q, cache_k, cache_v, jnp.asarray(pos))
 
 
 # -- Ulysses (all-to-all) sequence parallelism ------------------------------

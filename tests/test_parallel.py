@@ -179,3 +179,93 @@ class TestFlashBackward:
         assert worst < seq * seq, (
             f"O(L^2) intermediate found: {worst} elements")
         assert worst <= seq * 256
+
+
+class TestRingAttentionScale:
+    """VERDICT round-1 item 4: flash-kernel inner hops, causal hop
+    skipping, ring gradients, and sequence-parallel decode."""
+
+    def test_causal_hops_are_skipped(self):
+        # device i executes i+1 of the n hops under causal masking:
+        # sum over 8 devices = 36 executed hops, vs 64 for dense
+        from aiko_services_tpu.parallel import attention as attn_mod
+        mesh = create_mesh({"seq": 8})
+        q, k, v = _qkv(batch=1, heads=2, seq=64, dim=8)
+        executed = []
+        attn_mod._RING_HOP_CALLBACK = lambda step: executed.append(
+            int(step))
+        try:
+            out = ring_attention(q, k, v, mesh, causal=True)
+            jax.block_until_ready(out)
+        finally:
+            attn_mod._RING_HOP_CALLBACK = None
+        n = mesh.shape["seq"]
+        assert len(executed) == n * (n + 1) // 2, (
+            f"expected {n * (n + 1) // 2} executed hops, "
+            f"got {len(executed)}")
+        expected = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, expected, atol=2e-3, rtol=2e-3)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_grad_parity(self, causal):
+        mesh = create_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(batch=1, heads=2, seq=64, dim=8, seed=11)
+
+        def loss_ring(q, k, v):
+            out = ring_attention(q, k, v, mesh, causal=causal)
+            return jnp.sum(out * jnp.cos(out.astype(jnp.float32)))
+
+        def loss_ref(q, k, v):
+            out = attention_reference(q, k, v, causal=causal)
+            return jnp.sum(out * jnp.cos(out.astype(jnp.float32)))
+
+        got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for actual, expected, name in zip(got, want, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(actual), np.asarray(expected),
+                atol=5e-3, rtol=5e-3, err_msg=name)
+
+    @pytest.mark.parametrize("q_len", [1, 4])
+    def test_sp_decode_attention_parity(self, q_len):
+        from aiko_services_tpu.parallel import sp_decode_attention
+        mesh = create_mesh({"seq": 8})
+        cache_len, pos = 64, 37
+        _, k, v = _qkv(batch=2, heads=2, seq=cache_len, dim=8, seed=5)
+        q = jax.random.normal(jax.random.PRNGKey(9),
+                              (2, 2, q_len, 8), jnp.float32)
+        got = sp_decode_attention(q, k, v, pos, mesh=mesh)
+        # oracle: dense masked attention over positions <= pos(+i)
+        want = attention_reference(
+            q, k[:, :, :pos + q_len], v[:, :, :pos + q_len],
+            causal=True, q_offset=0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-3, rtol=2e-3)
+
+    def test_sp_decode_gqa_expands_in_shard(self):
+        # kv cache stays at n_kv_heads through the shard_map boundary;
+        # GQA expansion happens on the local shard only
+        from aiko_services_tpu.parallel import sp_decode_attention
+        mesh = create_mesh({"seq": 8})
+        _, k, v = _qkv(batch=1, heads=2, seq=32, dim=8, seed=8)
+        q = jax.random.normal(jax.random.PRNGKey(3), (1, 4, 1, 8),
+                              jnp.float32)
+        got = sp_decode_attention(q, k, v, 21, mesh=mesh)
+        k_rep = jnp.repeat(k, 2, axis=1)
+        v_rep = jnp.repeat(v, 2, axis=1)
+        want = attention_reference(q, k_rep[:, :, :22], v_rep[:, :, :22],
+                                   causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-3, rtol=2e-3)
+
+    def test_sp_decode_composes_with_tp(self):
+        from aiko_services_tpu.parallel import sp_decode_attention
+        mesh = create_mesh({"data": 2, "seq": 2, "model": 2})
+        _, k, v = _qkv(batch=2, heads=2, seq=32, dim=8, seed=6)
+        q = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 1, 8),
+                              jnp.float32)
+        got = sp_decode_attention(q, k, v, 19, mesh=mesh)
+        want = attention_reference(q, k[:, :, :20], v[:, :, :20],
+                                   causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-3, rtol=2e-3)
