@@ -17,6 +17,8 @@
 
 from __future__ import annotations
 
+import math
+
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -61,6 +63,19 @@ class TransformerConfig:
     # many experts; expert weights shard over the mesh "expert" axis
     # (param_specs), giving expert parallelism.  0 = dense FFN.
     n_experts: int = 0
+    # expert capacity = ceil(moe_capacity_factor * L / E) tokens per
+    # batch row; overflow tokens fall through on the residual.  <= 0
+    # selects the masked-dense oracle (every expert computes every
+    # token -- E x the FLOPs; only for tests/tiny E).
+    moe_capacity_factor: float = 1.25
+    # weight of the Switch load-balancing aux loss in make_train_step
+    moe_aux_weight: float = 0.01
+    # short sequences (L < E, i.e. incremental decode): gather only the
+    # selected expert's weights per token -- optimal when experts are
+    # replicated (single chip / no EP).  Set False when expert weights
+    # shard on the "expert" axis, where the dispatch einsum keeps weights
+    # stationary and moves (tiny) tokens instead.
+    moe_decode_gather: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -235,25 +250,33 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
     return dense(layer["wo"], out), cache_k, cache_v
 
 
-def _switch_moe(config: TransformerConfig, layer, x):
-    """Switch (top-1) mixture-of-experts FFN.
-
-    Masked-dense dispatch: every expert computes over all tokens and a
-    one-hot router mask selects the winner.  With expert weights sharded
-    on the "expert" mesh axis, XLA partitions the expert dimension so
-    each device runs only its local experts (true EP); compute per device
-    is E_local x the dense FFN.  A capacity-based gather dispatch (no
-    masked waste) is the production follow-up for large expert counts.
-    """
+def _router(config: TransformerConfig, layer, x):
+    """Top-1 router: returns (best (B,L) chosen expert ids, mask1 (B,L,E)
+    its one-hot, weight (B,L,1) its router probability, aux scalar
+    load-balancing loss).  aux = E * sum_e mean_tokens(mask1_e) *
+    mean_tokens(prob_e) (Switch Transformer loss; minimized at uniform
+    routing)."""
     router_logits = jnp.einsum(
         "bld,de->ble", x.astype(jnp.float32),
         layer["router"]["w"].astype(jnp.float32))
     router_probs = jax.nn.softmax(router_logits, axis=-1)
     best = jnp.argmax(router_probs, axis=-1)               # (B, L)
-    mask = jax.nn.one_hot(best, config.n_experts,
-                          dtype=jnp.float32)               # (B, L, E)
-    weight = jnp.sum(router_probs * mask, axis=-1,
+    mask1 = jax.nn.one_hot(best, config.n_experts,
+                           dtype=jnp.float32)              # (B, L, E)
+    weight = jnp.sum(router_probs * mask1, axis=-1,
                      keepdims=True)                        # (B, L, 1)
+    fraction = jnp.mean(mask1, axis=(0, 1))                # (E,)
+    prob_mass = jnp.mean(router_probs, axis=(0, 1))        # (E,)
+    aux = config.n_experts * jnp.sum(fraction * prob_mass)
+    return best, mask1, weight, aux
+
+
+def _switch_moe_dense(config: TransformerConfig, layer, x):
+    """Masked-dense switch dispatch: every expert computes every token, a
+    one-hot mask selects the winner.  Exact (no capacity drops) but costs
+    E x the dense FFN -- kept as the correctness oracle for the capacity
+    dispatch and for tiny expert counts."""
+    _, mask1, weight, aux = _router(config, layer, x)
     gate = jnp.einsum("bld,edf->blef", x, layer["w_gate"]["w"],
                       preferred_element_type=jnp.float32)
     up = jnp.einsum("bld,edf->blef", x, layer["w_up"]["w"],
@@ -261,28 +284,121 @@ def _switch_moe(config: TransformerConfig, layer, x):
     hidden = jax.nn.silu(gate) * up                        # (B, L, E, F)
     expert_out = jnp.einsum("blef,efd->bled", hidden,
                             layer["w_down"]["w"].astype(jnp.float32))
-    mixed = jnp.sum(expert_out * mask[..., None], axis=2)  # (B, L, D)
-    return (mixed * weight).astype(x.dtype)
+    mixed = jnp.sum(expert_out * mask1[..., None], axis=2)  # (B, L, D)
+    return (mixed * weight).astype(x.dtype), aux
+
+
+def _switch_moe(config: TransformerConfig, layer, x):
+    """Switch (top-1) MoE FFN with CAPACITY-BASED dispatch.
+
+    Each expert processes at most C = ceil(capacity_factor * L / E)
+    tokens per batch row: tokens gather into a dense (B, E, C, D) buffer
+    via a one-hot dispatch einsum (the TPU-friendly scatter -- static
+    shapes, MXU-shaped matmuls, no dynamic indexing), the FFN runs
+    batched over experts, and results scatter back weighted by the router
+    probability.  Per-token FLOPs are ~capacity_factor x the dense FFN --
+    independent of E.  Overflow tokens beyond an expert's capacity are
+    dropped (standard Switch behavior; the residual connection carries
+    them unchanged).  For short sequences (L < E, incremental decode)
+    the capacity floor of one slot per expert would cost E x the FFN, so
+    the path switches to per-token weight gather (moe_decode_gather).
+
+    With expert weights and the (B, E, C, ...) buffers sharded on the
+    "expert" mesh axis, each device computes only its local experts:
+    per-device FLOPs scale with E_local, not E (true expert parallelism);
+    XLA inserts the all-to-all-shaped collectives around the dispatch/
+    combine einsums.
+
+    Returns (output (B, L, D), aux load-balancing loss scalar).
+    """
+    if config.moe_capacity_factor <= 0:                    # oracle path
+        return _switch_moe_dense(config, layer, x)
+    batch, length, d_model = x.shape
+    experts = config.n_experts
+    if length < experts and config.moe_decode_gather:
+        # capacity would floor at 1 slot x E experts (E x the FLOPs);
+        # gather the chosen expert's weights per token instead
+        return _switch_moe_gather(config, layer, x)
+    capacity = max(1, math.ceil(
+        config.moe_capacity_factor * length / experts))
+    capacity = min(capacity, length)
+
+    _, mask1, weight, aux = _router(config, layer, x)
+    # position of each token within its expert's queue (per batch row)
+    position = jnp.cumsum(mask1, axis=1) * mask1           # 1-based
+    keep = mask1 * (position <= capacity)                  # (B, L, E)
+    disp = keep[..., None] * jax.nn.one_hot(
+        ((position - 1.0) * keep).astype(jnp.int32), capacity,
+        dtype=jnp.float32)
+    # disp: (B, L, E, C) one-hot dispatch/combine tensor.  Everything
+    # stays in model dtype into the MXU matmuls (one-hot selection is
+    # exact in bf16); accumulation is f32 via preferred_element_type.
+    expert_in = jnp.einsum("bld,blec->becd", x, disp.astype(x.dtype))
+    gate = jnp.einsum("becd,edf->becf", expert_in, layer["w_gate"]["w"],
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("becd,edf->becf", expert_in, layer["w_up"]["w"],
+                    preferred_element_type=jnp.float32)
+    hidden = (jax.nn.silu(gate) * up).astype(x.dtype)      # (B, E, C, F)
+    expert_out = jnp.einsum("becf,efd->becd", hidden,
+                            layer["w_down"]["w"],
+                            preferred_element_type=jnp.float32)
+    combine = disp * weight[..., None]                     # (B, L, E, C)
+    out = jnp.einsum("becd,blec->bld", expert_out, combine)
+    return out.astype(x.dtype), aux
+
+
+def _switch_moe_gather(config: TransformerConfig, layer, x):
+    """Per-token expert-weight GATHER dispatch for short sequences
+    (incremental decode, L < E): read only the selected expert's weight
+    rows -- per-token FLOPs and HBM reads equal ONE dense FFN, vs the
+    capacity path's E floor-of-one slots.  Optimal when expert weights
+    are replicated (single chip); under EP sharding prefer the dispatch
+    einsums (moe_decode_gather=False) so weights stay stationary."""
+    best, _, weight, aux = _router(config, layer, x)
+    wg = jnp.take(layer["w_gate"]["w"], best, axis=0)      # (B, L, D, F)
+    wu = jnp.take(layer["w_up"]["w"], best, axis=0)
+    wd = jnp.take(layer["w_down"]["w"], best, axis=0)      # (B, L, F, D)
+    gate = jnp.einsum("bld,bldf->blf", x, wg,
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("bld,bldf->blf", x, wu,
+                    preferred_element_type=jnp.float32)
+    hidden = (jax.nn.silu(gate) * up).astype(x.dtype)
+    out = jnp.einsum("blf,blfd->bld", hidden, wd,
+                     preferred_element_type=jnp.float32)
+    return (out * weight).astype(x.dtype), aux
 
 
 def forward(params: dict, config: TransformerConfig, tokens,
             cache: dict | None = None, pos: int = 0,
-            activation_specs: bool = False):
+            activation_specs: bool = False, return_aux: bool = False):
     """tokens (B, L) int32 -> logits (B, L, V) [+ updated cache].
 
     With cache=None this is a pure causal prefill (training / scoring).
     With a cache, K/V are written at `pos` (traced or static int) and the
     updated cache is returned -- the incremental-decode path.
+    return_aux=True (cache-less path only) additionally returns the mean
+    MoE load-balancing loss across layers (0.0 for dense FFN).
     """
+    if return_aux and cache is not None:
+        raise ValueError(
+            "return_aux is only meaningful on the cache-less (training/"
+            "scoring) path; with a cache forward returns (logits, cache)")
+    if activation_specs:
+        # batch on "data", sequence on "seq" -- but only the axes the
+        # ambient mesh actually has (an EP-only mesh has no "seq")
+        names = jax.sharding.get_abstract_mesh().axis_names
+        act_spec = P("data" if "data" in names else None,
+                     "seq" if "seq" in names else None, None)
     h = jnp.take(params["embed"]["w"], tokens, axis=0)
     if activation_specs:
-        h = jax.lax.with_sharding_constraint(h, P("data", "seq", None))
+        h = jax.lax.with_sharding_constraint(h, act_spec)
     positions = pos + jnp.arange(tokens.shape[1])
     cos, sin = rotary_embedding(positions, config.head_dim,
                                 config.rope_theta)
     cos, sin = cos[None, None], sin[None, None]  # (1, 1, L, hd/2)
 
-    def layer_step(h, xs):
+    def layer_step(carry, xs):
+        h, aux_sum = carry
         layer, layer_cache = xs
         attn_out, new_k, new_v = _attention(
             config, layer, rms_norm(layer["attn_norm"], h, config.norm_eps),
@@ -293,7 +409,8 @@ def forward(params: dict, config: TransformerConfig, tokens,
         h = h + attn_out
         mlp_in = rms_norm(layer["mlp_norm"], h, config.norm_eps)
         if config.n_experts > 0:
-            mlp_out = _switch_moe(config, layer, mlp_in)
+            mlp_out, aux = _switch_moe(config, layer, mlp_in)
+            aux_sum = aux_sum + aux
         else:
             mlp_out = dense(
                 layer["w_down"],
@@ -301,19 +418,20 @@ def forward(params: dict, config: TransformerConfig, tokens,
                 * dense(layer["w_up"], mlp_in))
         h = h + mlp_out
         if activation_specs:
-            h = jax.lax.with_sharding_constraint(h, P("data", "seq", None))
+            h = jax.lax.with_sharding_constraint(h, act_spec)
         new_cache = (None if new_k is None
                      else {"k": new_k, "v": new_v})
-        return h, new_cache
+        return (h, aux_sum), new_cache
 
+    aux0 = jnp.zeros((), jnp.float32)
     if cache is None:
-        h, _ = jax.lax.scan(
+        (h, aux_sum), _ = jax.lax.scan(
             lambda carry, layer: layer_step(carry, (layer, None)),
-            h, params["layers"])
+            (h, aux0), params["layers"])
         new_cache = None
     else:
-        h, new_cache = jax.lax.scan(layer_step, h,
-                                    (params["layers"], cache))
+        (h, aux_sum), new_cache = jax.lax.scan(
+            layer_step, (h, aux0), (params["layers"], cache))
     h = rms_norm(params["norm_out"], h, config.norm_eps)
     # untied output head when the checkpoint ships one (Llama-3-8B+,
     # models/weights.py load_llama_params); tied embedding otherwise
@@ -321,6 +439,8 @@ def forward(params: dict, config: TransformerConfig, tokens,
     logits = jnp.einsum("bld,vd->blv", h.astype(jnp.float32),
                         head.astype(jnp.float32))
     if new_cache is None:
+        if return_aux:
+            return logits, aux_sum / max(config.n_layers, 1)
         return logits
     return logits, new_cache
 
@@ -439,13 +559,13 @@ def make_train_step(config: TransformerConfig, optimizer,
     for mesh execution."""
 
     def loss_fn(params, tokens):
-        logits = forward(params, config, tokens[:, :-1],
-                         activation_specs=sharded)
+        logits, aux = forward(params, config, tokens[:, :-1],
+                              activation_specs=sharded, return_aux=True)
         targets = tokens[:, 1:]
         log_probs = jax.nn.log_softmax(logits, axis=-1)
         taken = jnp.take_along_axis(
             log_probs, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(taken)
+        return -jnp.mean(taken) + config.moe_aux_weight * aux
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens):
