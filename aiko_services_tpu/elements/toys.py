@@ -12,7 +12,7 @@ from ..utils import get_logger
 from .common_io import DataSource
 
 __all__ = ["PE_Number", "PE_Add", "PE_Multiply", "PE_Sum2", "PE_Inspect",
-           "PE_Metrics", "PE_RandomIntegers"]
+           "PE_Metrics", "PE_RandomIntegers", "PE_RandomTensor", "PE_Sum"]
 
 _LOGGER = get_logger("toys")
 
@@ -78,3 +78,22 @@ class PE_RandomIntegers(DataSource):
         seed = int(item)
         value = (seed * 1103515245 + 12345) % 2147483648
         return {"number": value % 100}
+
+
+class PE_RandomTensor(DataSource):
+    """Tensor source for data-plane load tests: data_sources items are
+    element counts; emits {"values": float32 array} (deterministic)."""
+
+    def read_item(self, stream, item) -> dict:
+        import numpy as np
+        count = int(item)
+        rng = np.random.default_rng(count)
+        return {"values": rng.standard_normal(count).astype(np.float32)}
+
+
+class PE_Sum(PipelineElement):
+    """Reduce a tensor input to its scalar sum."""
+
+    def process_frame(self, stream, values):
+        import numpy as np
+        return StreamEvent.OKAY, {"number": float(np.sum(values))}

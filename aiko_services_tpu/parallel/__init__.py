@@ -6,3 +6,6 @@ from .attention import (                                      # noqa: F401
     ring_attention_sharded, sp_decode_attention,
     sp_decode_attention_sharded, ulysses_attention,
     ulysses_attention_sharded)
+from .distributed import (                                    # noqa: F401
+    global_mesh, initialize_distributed, is_distributed, process_count,
+    process_index, shutdown_distributed)

@@ -8,5 +8,8 @@ from .element import PipelineElement, FrameGeneratorHandle     # noqa: F401
 from .pipeline import Pipeline, RemoteElement, create_pipeline  # noqa: F401
 from .tensors import (                                         # noqa: F401
     encode_frame_data, decode_frame_data, encode_value, decode_value)
+from .transfer import (                                        # noqa: F401
+    TensorTransferServer, fetch as fetch_tensor, get_transfer_server,
+    reset_transfer_server)
 from .tpu_element import (                                     # noqa: F401
     ComputeElement, bucket_length, pad_axis_to)

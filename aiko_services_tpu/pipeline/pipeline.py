@@ -300,9 +300,7 @@ class Pipeline(Actor):
         try:
             if isinstance(stream_dict, str):
                 stream_dict = json.loads(stream_dict)
-            if isinstance(frame_data, str):
-                frame_data = decode_frame_data(frame_data)
-        except (ValueError, KeyError) as error:
+        except ValueError as error:
             _LOGGER.warning("%s: undecodable frame response dropped: %s",
                             self.name, error)
             return
@@ -318,6 +316,19 @@ class Pipeline(Actor):
             _LOGGER.debug("%s: response for unknown frame %s/%s",
                           self.name, stream_id, frame_id)
             return
+        if isinstance(frame_data, str):
+            try:
+                frame_data = decode_frame_data(frame_data)
+            except (ValueError, KeyError) as error:
+                # payload unrecoverable (e.g. transfer-plane producer
+                # died): release the parked frame as an error instead of
+                # leaking it until the stream lease expires
+                _LOGGER.warning(
+                    "%s: frame response payload lost (%s); releasing "
+                    "frame %s/%s", self.name, error, stream_id, frame_id)
+                frame.paused_pe_name = None
+                self._finish_frame(stream, frame, dropped=True, error=True)
+                return
         remote_event = stream_dict.get("event")
         if remote_event:  # remote dropped/errored the frame: release it
             frame.paused_pe_name = None

@@ -1,14 +1,20 @@
 # Tensor-aware frame-data codec for CROSS-PROCESS hops only.
 #
 # In-process, swag values (including jax.Array) pass by reference and never
-# touch this codec.  When a frame crosses a process boundary, values are
-# JSON-encoded with numpy/jax arrays carried as base64 .npy blobs -- a
-# binary-safe, self-describing replacement for the reference's ad-hoc
-# base64/zlib user elements (reference: PE_DataEncode/Decode,
-# src/aiko_services/examples/pipeline/elements.py:298-324, and audio
-# PE_RemoteSend, elements/media/audio_io.py:520-560).  Large-tensor
-# cross-host transfer over ICI/DCN bypasses this path entirely (the mesh
-# data plane in parallel/).
+# touch this codec.  When a frame crosses a process boundary:
+#
+#   large arrays (>= AIKO_TRANSFER_THRESHOLD, default 64 KiB) are staged
+#   on the per-process TensorTransferServer and travel as a tiny JSON
+#   DESCRIPTOR -- the control plane never carries bulk data (SURVEY.md 5;
+#   the reference pushed base64 tensors through the broker:
+#   src/aiko_services/examples/pipeline/elements.py:298-324, audio binary
+#   topics audio_io.py:520-560 / process.py:180-189);
+#
+#   small values stay inline as base64 .npy blobs -- a descriptor +
+#   socket round-trip costs more than the payload.
+#
+# Within a mesh, sharded compute bypasses both paths entirely (XLA
+# collectives over ICI/DCN -- the parallel/ data plane).
 
 from __future__ import annotations
 
@@ -18,6 +24,10 @@ import json
 import zlib
 
 import numpy as np
+
+from .transfer import (
+    TENSOR_REF_KEY, fetch, get_transfer_server, transfer_enabled,
+    transfer_threshold)
 
 __all__ = ["encode_frame_data", "decode_frame_data", "encode_value",
            "decode_value"]
@@ -30,6 +40,8 @@ def encode_value(value):
     if hasattr(value, "__array__") and not isinstance(
             value, (bool, int, float, str, list, tuple, dict)):
         array = np.asarray(value)
+        if transfer_enabled() and array.nbytes >= transfer_threshold():
+            return {TENSOR_REF_KEY: get_transfer_server().offer(array)}
         buffer = io.BytesIO()
         np.save(buffer, array, allow_pickle=False)
         raw = buffer.getvalue()
@@ -48,6 +60,8 @@ def encode_value(value):
 
 def decode_value(value):
     if isinstance(value, dict):
+        if TENSOR_REF_KEY in value:
+            return fetch(value[TENSOR_REF_KEY])
         if _NDARRAY_KEY in value:
             record = value[_NDARRAY_KEY]
             raw = base64.b64decode(record["data"])

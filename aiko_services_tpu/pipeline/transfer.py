@@ -1,0 +1,264 @@
+# Binary tensor transfer plane for CROSS-PROCESS pipeline hops.
+#
+# The reference moves tensors between processes as base64/zlib text through
+# the MQTT broker (reference: src/aiko_services/examples/pipeline/
+# elements.py:298-324 PE_DataEncode/Decode; elements/media/audio_io.py:
+# 520-560 PE_RemoteSend binary topics, enabled by process.py:180-189).
+# Routing bulk data through a broker caps throughput at the broker.
+#
+# Here the data plane is split from the control plane (SURVEY.md 5,
+# "Distributed communication backend"): the broker carries only a small
+# JSON DESCRIPTOR {host, port, key, dtype, shape}; the bytes ride a direct
+# TCP connection between the producing and consuming processes.  Within a
+# mesh, sharded compute never touches this path (XLA collectives over
+# ICI/DCN); the transfer plane covers pipeline-stage hand-off between
+# framework Processes on one or many hosts.
+#
+# Protocol (one request per connection):
+#   client -> server: 32-byte hex key + "\n"
+#   server -> client: 8-byte big-endian length + raw array bytes
+#                     (length 0 = unknown/expired key)
+# dtype/shape travel in the descriptor, so the wire carries nothing but
+# the buffer.
+#
+# Failure contract: fetch() raises TransferError (a ValueError) on any
+# network fault and KeyError on expired/consumed keys -- both inside the
+# pipeline engine's undecodable-frame handling, so a dead producer drops
+# the frame instead of killing the consumer's event loop.
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+import uuid
+
+import numpy as np
+
+__all__ = [
+    "TensorTransferServer", "TransferError", "fetch",
+    "get_transfer_server", "transfer_enabled", "transfer_threshold",
+    "reset_transfer_server",
+]
+
+_HEADER = struct.Struct("!Q")
+_KEY_BYTES = 32  # uuid4().hex
+_PURGE_INTERVAL = 5.0
+
+TENSOR_REF_KEY = "__tensorref__"
+
+
+class TransferError(ValueError):
+    """A transfer-plane fetch failed (producer unreachable, stream cut).
+    Subclasses ValueError so pipeline frame decoding treats it as an
+    undecodable frame (dropped + logged), never a crashed handler."""
+
+
+def transfer_enabled() -> bool:
+    """Kill switch: AIKO_TRANSFER=0 forces every cross-process tensor
+    back onto the inline base64 codec path."""
+    return os.environ.get("AIKO_TRANSFER", "1") not in ("0", "false")
+
+
+def transfer_threshold() -> int:
+    """Arrays at or above this many bytes ride the transfer plane;
+    smaller values stay inline in the control message (a descriptor +
+    round-trip costs more than a small payload)."""
+    return int(os.environ.get("AIKO_TRANSFER_THRESHOLD", str(1 << 16)))
+
+
+def transfer_timeout() -> float:
+    """Socket timeout for fetches.  Fetches run on the consumer's event
+    loop, so this bounds how long one lost producer can stall the
+    process; keep it well under stream grace leases."""
+    return float(os.environ.get("AIKO_TRANSFER_TIMEOUT", "10"))
+
+
+def _advertised_host() -> str:
+    """The address peers should dial: env override, else this host's
+    outbound interface (UDP connect trick -- no packets sent), else the
+    resolved hostname, else loopback (single-host deployments)."""
+    override = os.environ.get("AIKO_TRANSFER_HOST")
+    if override:
+        return override
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+            probe.connect(("10.255.255.255", 1))
+            address = probe.getsockname()[0]
+        if address and not address.startswith("127."):
+            return address
+    except OSError:
+        pass
+    try:
+        address = socket.gethostbyname(socket.gethostname())
+        if address and not address.startswith("127."):
+            return address
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 & friends (ships with jax)
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class TensorTransferServer:
+    """Per-process tensor side-channel: offered arrays are served by key
+    until fetched once (or until ttl expires; expiry is enforced both on
+    offer() and periodically by the accept loop)."""
+
+    def __init__(self, host: str | None = None, port: int = 0,
+                 ttl: float = 300.0):
+        self.ttl = float(ttl)
+        self._store: dict[str, tuple[float, np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", int(port)))
+        self._listener.listen(16)
+        self._listener.settimeout(_PURGE_INTERVAL)
+        self.port = self._listener.getsockname()[1]
+        self.host = host or _advertised_host()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="tensor_transfer", daemon=True)
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------
+
+    def offer(self, array) -> dict:
+        """Stage an array for one remote fetch; returns its descriptor."""
+        array = np.ascontiguousarray(np.asarray(array))
+        key = uuid.uuid4().hex
+        with self._lock:
+            self._store[key] = (time.monotonic() + self.ttl, array)
+        self._purge()
+        return {"host": self.host, "port": self.port, "key": key,
+                "dtype": str(array.dtype), "shape": list(array.shape)}
+
+    def _purge(self):
+        now = time.monotonic()
+        with self._lock:
+            expired = [key for key, (deadline, _) in self._store.items()
+                       if deadline < now]
+            for stale in expired:
+                del self._store[stale]
+
+    # -- server side ---------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                self._purge()  # unfetched arrays die on schedule
+                continue
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket):
+        try:
+            conn.settimeout(transfer_timeout())
+            request = b""
+            while not request.endswith(b"\n"):
+                chunk = conn.recv(_KEY_BYTES + 1 - len(request))
+                if not chunk:
+                    return
+                request += chunk
+            key = request.strip().decode("ascii", "replace")
+            with self._lock:
+                entry = self._store.pop(key, None)
+            if entry is None:
+                conn.sendall(_HEADER.pack(0))
+                return
+            _, array = entry
+            try:  # zero-copy stream of the contiguous buffer
+                view = memoryview(array).cast("B")
+            except (TypeError, ValueError, BufferError):
+                view = array.tobytes()  # exotic dtypes without buffers
+            conn.sendall(_HEADER.pack(array.nbytes))
+            conn.sendall(view)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            self._store.clear()
+
+
+def fetch(descriptor: dict, timeout: float | None = None) -> np.ndarray:
+    """Dial the descriptor's producer and pull the raw buffer.
+
+    Returns a WRITABLE array (received into a fresh bytearray).  Raises
+    KeyError for consumed/expired keys, TransferError for network faults.
+    """
+    if timeout is None:
+        timeout = transfer_timeout()
+    address = (descriptor["host"], int(descriptor["port"]))
+    try:
+        with socket.create_connection(address, timeout=timeout) as conn:
+            conn.settimeout(timeout)
+            conn.sendall(descriptor["key"].encode("ascii") + b"\n")
+            header = _recv_exact(conn, _HEADER.size)
+            (length,) = _HEADER.unpack(header)
+            if length == 0:
+                raise KeyError(
+                    f"tensor {descriptor['key']} expired or already "
+                    f"fetched from {address[0]}:{address[1]}")
+            raw = _recv_exact(conn, length)
+    except OSError as error:
+        raise TransferError(
+            f"tensor fetch from {address[0]}:{address[1]} failed: "
+            f"{error}") from error
+    array = np.frombuffer(raw, dtype=_resolve_dtype(descriptor["dtype"]))
+    return array.reshape(descriptor["shape"])
+
+
+def _recv_exact(conn: socket.socket, count: int) -> bytearray:
+    buffer = bytearray(count)
+    view = memoryview(buffer)
+    received = 0
+    while received < count:
+        chunk = conn.recv_into(view[received:], count - received)
+        if not chunk:
+            raise ConnectionError(
+                "tensor transfer connection closed mid-stream")
+        received += chunk
+    return buffer
+
+
+_SERVER: TensorTransferServer | None = None
+_SERVER_LOCK = threading.Lock()
+
+
+def get_transfer_server() -> TensorTransferServer:
+    """Lazily started per-process singleton (first large tensor to cross
+    a process boundary brings the listener up)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is None or _SERVER._closed:
+            _SERVER = TensorTransferServer()
+        return _SERVER
+
+
+def reset_transfer_server():
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.close()
+            _SERVER = None
