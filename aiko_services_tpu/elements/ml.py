@@ -24,8 +24,8 @@ from ..ops import log_mel_spectrogram
 from ..pipeline import ComputeElement, PipelineElement, StreamEvent
 from ..utils import get_logger
 
-__all__ = ["LMForward", "LMGenerate", "SpeechToText", "Detector",
-           "TokensToText", "TextToTokens"]
+__all__ = ["LMForward", "LMGenerate", "SpeechToText", "TextToSpeech",
+           "Detector", "TokensToText", "TextToTokens"]
 
 _LOGGER = get_logger("ml_elements")
 
@@ -220,6 +220,58 @@ class SpeechToText(ComputeElement):
         tokens = transcribe(self.state, self.config, mel,
                             max_tokens=max_tokens)
         return StreamEvent.OKAY, {"tokens": tokens}
+
+
+class TextToSpeech(ComputeElement):
+    """text -> waveform (B, samples) f32 + sample_rate: the reference's
+    Coqui TTS seat (reference speech_elements.py:109-146, Coqui vits on
+    CUDA).  Characters -> mel -> Griffin-Lim runs as ONE jit on the
+    element's mesh (models/tts.py).  Prompt lengths pad to power-of-two
+    buckets so repeated frames share a compilation; "max_chars"
+    (default 512) caps the ladder, warning on truncation."""
+
+    def setup(self):
+        from ..models.tts import TTSConfig, init_tts_params
+        self.config = TTSConfig(
+            d_model=int(self.get_parameter("d_model", 256)),
+            n_conv_layers=int(self.get_parameter("n_conv_layers", 4)),
+            sample_rate=int(self.get_parameter("sample_rate", 16000)),
+            frames_per_char=int(
+                self.get_parameter("frames_per_char", 6)),
+            griffin_lim_iters=int(
+                self.get_parameter("griffin_lim_iters", 30)),
+        )
+        weights = self.get_parameter("weights")
+        if weights:
+            params = load_pytree(weights, dtype=self.config.dtype)
+        else:
+            params = init_tts_params(
+                self.config,
+                jax.random.PRNGKey(int(self.get_parameter("seed", 0))))
+        _LOGGER.info("%s: TTS %.1fM params", self.definition.name,
+                     count_params(params) / 1e6)
+        return params
+
+    def process_frame(self, stream, text):
+        from ..models.tts import encode_chars, synthesize
+        from ..utils.padding import bucket_length
+        self._ensure_ready()
+        prompts = [text] if isinstance(text, str) else list(text)
+        max_chars = int(self.get_parameter("max_chars", 512, stream))
+        longest = max((len(prompt.encode("utf-8", "replace"))
+                       for prompt in prompts), default=1)
+        if longest > max_chars:
+            _LOGGER.warning(
+                "%s: prompt of %d chars truncated to max_chars=%d",
+                self.definition.name, longest, max_chars)
+        width = bucket_length(min(longest, max_chars), minimum=16)
+        chars = np.concatenate(
+            [encode_chars(prompt, max_len=width)
+             for prompt in prompts])
+        waveform = synthesize(self.state, self.config,
+                              jnp.asarray(chars))
+        return StreamEvent.OKAY, {
+            "audio": waveform, "sample_rate": self.config.sample_rate}
 
 
 class TokensToText(PipelineElement):

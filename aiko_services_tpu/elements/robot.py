@@ -1,0 +1,164 @@
+# Robot actor seat: a simulated robot driven by S-expression actions.
+#
+# Capability parity with the reference's XGO robot-dog stack (reference:
+# src/aiko_services/examples/xgo_robot/xgo_robot.py + robot_control.py,
+# 807 LoC): an Actor that accepts "(action name args...)" commands --
+# the contract the reference LLM element emits (elements_llm.py:137-179's
+# S-expression-constrained system prompt) -- plus a pipeline element that
+# parses LM output text into robot actions and forwards them to a
+# discovered robot service.
+#
+# The reference drives real XGO hardware over serial; here the actuation
+# backend is pluggable: SimulatedRobot integrates simple kinematics (the
+# hermetic default, also the CI story the reference never had), and a
+# hardware backend can subclass RobotActor and override _apply.
+
+from __future__ import annotations
+
+import math
+import re
+import time
+
+from ..pipeline import PipelineElement, StreamEvent
+from ..runtime.actor import Actor
+from ..utils import get_logger
+
+__all__ = ["RobotActor", "RobotControl", "parse_actions"]
+
+_LOGGER = get_logger("robot")
+
+# action name -> (parameter names, defaults); mirrors the reference robot
+# vocabulary (xgo_robot.py action handlers: move/turn/stop/pose/speak)
+ACTIONS = {
+    "move": (("distance",), (0.1,)),       # meters, +forward
+    "turn": (("degrees",), (15.0,)),       # +counter-clockwise
+    "stop": ((), ()),
+    "pose": (("name",), ("stand",)),
+    "speak": (("text",), ("",)),
+}
+
+
+class RobotActor(Actor):
+    """Discoverable robot service: "(action move 0.5)" etc. on its /in
+    topic move the (simulated) robot; pose/odometry live in the EC share
+    so dashboards and controllers mirror robot state like any service."""
+
+    def __init__(self, process, name: str = "robot", protocol=None):
+        super().__init__(process, name,
+                         protocol=protocol or "robot:0")
+        self.share.update({
+            "x": 0.0, "y": 0.0, "heading": 0.0, "pose": "stand",
+            "odometer": 0.0, "actions": 0, "last_action": "",
+            "utterances": 0,
+        })
+        self.history: list[tuple] = []
+
+    # -- the wire command ----------------------------------------------
+
+    def action(self, name, *args):
+        """(action <name> <args...>) -- validate against the action
+        vocabulary and apply; unknown actions are logged, not fatal
+        (the LM may hallucinate)."""
+        name = str(name)
+        if name not in ACTIONS:
+            _LOGGER.warning("%s: unknown action: %s", self.name, name)
+            return
+        self.history.append((name, args, time.time()))
+        self._apply(name, args)
+        self._update_share("actions", int(self.share["actions"]) + 1)
+        self._update_share(
+            "last_action",
+            f"{name} {' '.join(str(a) for a in args)}".strip())
+
+    # -- simulated kinematics (override for hardware) ------------------
+
+    def _apply(self, name: str, args: tuple):
+        if name == "move":
+            distance = float(args[0]) if args else ACTIONS["move"][1][0]
+            heading = math.radians(float(self.share["heading"]))
+            self._update_share(
+                "x", round(float(self.share["x"])
+                           + distance * math.cos(heading), 6))
+            self._update_share(
+                "y", round(float(self.share["y"])
+                           + distance * math.sin(heading), 6))
+            self._update_share(
+                "odometer",
+                round(float(self.share["odometer"]) + abs(distance), 6))
+        elif name == "turn":
+            degrees = float(args[0]) if args else ACTIONS["turn"][1][0]
+            self._update_share(
+                "heading",
+                round((float(self.share["heading"]) + degrees) % 360.0,
+                      6))
+        elif name == "pose":
+            self._update_share(
+                "pose", str(args[0]) if args else "stand")
+        elif name == "speak":
+            self._update_share(
+                "utterances", int(self.share["utterances"]) + 1)
+        # "stop" only records history/last_action
+
+    def _update_share(self, key, value):
+        self.share[key] = value
+        if self.ec_producer is not None:
+            self.ec_producer.update(key, value)
+
+
+_ACTION_PATTERN = re.compile(r"\(\s*action\s+([^()]+?)\s*\)")
+
+
+def parse_actions(text: str) -> list[tuple[str, list[str]]]:
+    """Extract (action name args...) commands from free-form LM output
+    (the reference constrains the LLM to this grammar,
+    elements_llm.py:137-179)."""
+    actions = []
+    for match in _ACTION_PATTERN.finditer(text or ""):
+        parts = match.group(1).split()
+        if parts:
+            actions.append((parts[0], parts[1:]))
+    return actions
+
+
+class RobotControl(PipelineElement):
+    """Pipeline bridge LM -> robot: parses "(action ...)" commands out of
+    generated text and forwards them to a robot service by proxy (the
+    reference's robot_control loop).  The robot is addressed either
+    directly ("robot_topic" parameter) or by registrar discovery
+    ("robot_service" name).  Emits the parsed actions so graphs can also
+    fan them into recorders/dashboards."""
+
+    def _robot_proxy(self, stream):
+        from ..runtime.proxy import make_proxy
+        target = self.get_parameter("robot_topic", None, stream)
+        if target:
+            return make_proxy(self.process, str(target))
+        name = self.get_parameter("robot_service", None, stream)
+        if not name:
+            return None
+        from ..runtime import ServiceFilter
+        from ..runtime.share import services_cache_create_singleton
+        cache = services_cache_create_singleton(self.process)
+        matches = list(cache.services.filter_services(
+            ServiceFilter(name=str(name))))
+        if not matches:
+            _LOGGER.warning("%s: robot service '%s' not discovered yet",
+                            self.definition.name, name)
+            return None
+        return make_proxy(self.process, matches[0].topic_path)
+
+    def process_frame(self, stream, text):
+        prompts = [text] if isinstance(text, str) else list(text)
+        parsed = []
+        for item in prompts:
+            parsed.extend(parse_actions(str(item)))
+        sent = 0
+        if parsed:
+            proxy = self._robot_proxy(stream)
+            if proxy is not None:
+                for name, args in parsed:
+                    proxy.action(name, *args)
+                    sent += 1
+        return StreamEvent.OKAY, {
+            "actions": [[name] + list(args) for name, args in parsed],
+            "dispatched": sent}

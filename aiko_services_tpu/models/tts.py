@@ -1,0 +1,183 @@
+# Text-to-speech: the framework's TTS seat, filling the reference's Coqui
+# TTS element (reference: src/aiko_services/examples/speech/
+# speech_elements.py:109-146 -- PE_TextToSpeech wrapping TTS
+# "tts_models/en/vctk/vits" on CUDA, 594 MB VRAM).
+#
+# TPU-first design -- everything from characters to waveform is ONE jit:
+#   chars (B, L) -> embedding -> static-duration upsample (frames_per_char,
+#   jit-friendly static shapes; no autoregressive loop) -> 1D conv decoder
+#   -> mel (B, n_mels, T) -> mel-to-linear (precomputed filterbank
+#   pseudo-inverse, an MXU matmul) -> Griffin-Lim phase recovery
+#   (lax.fori_loop of STFT/ISTFT round-trips on jnp.fft) -> waveform.
+#
+# Weights are random-initialized at the element level (same policy as the
+# LM/ASR/detector families: real checkpoints load through
+# models/weights.py load_pytree); the synthesis chain, shapes, and the
+# vocoder are the production path.
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.audio import mel_filterbank
+from .layers import dense, init_dense
+
+__all__ = [
+    "TTSConfig", "init_tts_params", "synthesize_mel", "griffin_lim",
+    "synthesize", "encode_chars",
+]
+
+
+@dataclass(frozen=True)
+class TTSConfig:
+    vocab_size: int = 256          # byte-level characters
+    d_model: int = 256
+    n_conv_layers: int = 4
+    kernel_size: int = 5
+    n_mels: int = 80
+    sample_rate: int = 16000
+    n_fft: int = 400
+    hop: int = 200                 # 12.5 ms
+    frames_per_char: int = 6       # ~75 ms per character
+    griffin_lim_iters: int = 30
+    dtype: str = "float32"
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def encode_chars(text: str, max_len: int | None = None) -> np.ndarray:
+    """Byte-level character ids (1, L) int32; optionally padded/truncated
+    to max_len with zeros (id 0 = padding/silence)."""
+    ids = np.frombuffer(text.encode("utf-8", "replace"),
+                        np.uint8).astype(np.int32)
+    if max_len is not None:
+        ids = ids[:max_len]
+        ids = np.pad(ids, (0, max_len - len(ids)))
+    return ids[None]
+
+
+def init_tts_params(config: TTSConfig, key) -> dict:
+    """Conv layers are STACKED on a leading axis (like every model
+    family here) so save_pytree/load_pytree/shard_pytree apply
+    unchanged; synthesize_mel runs them with lax.scan."""
+    keys = jax.random.split(key, config.n_conv_layers + 3)
+    dtype = config.jnp_dtype
+    scale = 1.0 / np.sqrt(config.d_model * config.kernel_size)
+    conv_w = jnp.stack([
+        (jax.random.normal(
+            keys[2 + index],
+            (config.kernel_size, config.d_model, config.d_model),
+            jnp.float32) * scale).astype(dtype)
+        for index in range(config.n_conv_layers)])
+    return {
+        "embed": {"w": (jax.random.normal(
+            keys[0], (config.vocab_size, config.d_model), jnp.float32)
+            * 0.02).astype(dtype)},
+        "convs": {"w": conv_w,
+                  "b": jnp.zeros(
+                      (config.n_conv_layers, config.d_model), dtype)},
+        "mel_out": init_dense(keys[1], config.d_model, config.n_mels,
+                              dtype),
+    }
+
+
+def synthesize_mel(params: dict, config: TTSConfig, chars) -> jnp.ndarray:
+    """chars (B, L) int32 -> mel (B, n_mels, L * frames_per_char).
+
+    Static-duration upsampling keeps every shape known at trace time (no
+    data-dependent durations -> no recompiles, scan-free decode)."""
+    h = jnp.take(params["embed"]["w"], chars, axis=0)   # (B, L, D)
+    h = jnp.repeat(h, config.frames_per_char, axis=1)   # (B, T, D)
+    # position-within-char phase feature lets the convs shape transients
+    phase = jnp.tile(
+        jnp.arange(config.frames_per_char, dtype=jnp.float32)
+        / config.frames_per_char, chars.shape[1])
+    h = h + jnp.sin(2 * jnp.pi * phase)[None, :, None].astype(h.dtype)
+
+    def conv_block(h, conv):
+        y = jax.lax.conv_general_dilated(
+            h, conv["w"], window_strides=(1,), padding="SAME",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        return h + jnp.tanh(y + conv["b"]), None        # residual
+
+    h, _ = jax.lax.scan(conv_block, h, params["convs"])
+    mel = dense(params["mel_out"], h)                   # (B, T, n_mels)
+    return mel.transpose(0, 2, 1)                       # (B, n_mels, T)
+
+
+def _frame(signal, n_fft: int, hop: int):
+    """(B, S) -> (B, frames, n_fft) strided windows via gather (XLA turns
+    the static index matrix into an efficient slice pattern)."""
+    frames = 1 + (signal.shape[-1] - n_fft) // hop
+    index = (jnp.arange(frames)[:, None] * hop
+             + jnp.arange(n_fft)[None, :])
+    return signal[:, index]
+
+
+def _stft(signal, n_fft: int, hop: int, window):
+    return jnp.fft.rfft(_frame(signal, n_fft, hop) * window, axis=-1)
+
+
+def _istft(spec, n_fft: int, hop: int, window, length: int):
+    frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) * window
+    batch, n_frames, _ = frames.shape
+    signal = jnp.zeros((batch, length), frames.dtype)
+    window_sum = jnp.zeros((length,), frames.dtype)
+    positions = (jnp.arange(n_frames)[:, None] * hop
+                 + jnp.arange(n_fft)[None, :])       # (frames, n_fft)
+    flat = positions.reshape(-1)
+    signal = signal.at[:, flat].add(
+        frames.reshape(batch, -1))
+    window_sum = window_sum.at[flat].add(
+        jnp.tile(window * window, (n_frames, 1)).reshape(-1))
+    return signal / jnp.maximum(window_sum, 1e-8)[None, :]
+
+
+def griffin_lim(magnitude, config: TTSConfig) -> jnp.ndarray:
+    """Phase recovery: magnitude (B, n_fft//2+1, T) -> waveform (B, S).
+
+    Classic Griffin-Lim as a lax.fori_loop of ISTFT/STFT round-trips --
+    fully on-device, jit-compiled with the synthesis net."""
+    n_fft, hop = config.n_fft, config.hop
+    magnitude = magnitude.transpose(0, 2, 1)            # (B, T, bins)
+    frames = magnitude.shape[1]
+    length = (frames - 1) * hop + n_fft
+    window = jnp.hanning(n_fft).astype(jnp.float32)
+    angles = jnp.zeros_like(magnitude)                  # deterministic
+
+    def body(_, angles):
+        signal = _istft(magnitude * jnp.exp(1j * angles), n_fft, hop,
+                        window, length)
+        rebuilt = _stft(signal, n_fft, hop, window)
+        return jnp.angle(rebuilt)
+
+    angles = jax.lax.fori_loop(0, config.griffin_lim_iters, body, angles)
+    return _istft(magnitude * jnp.exp(1j * angles), n_fft, hop, window,
+                  length)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def synthesize(params: dict, config: TTSConfig, chars) -> jnp.ndarray:
+    """chars (B, L) int32 -> waveform (B, S) float32 in [-1, 1]: the full
+    text->speech chain as ONE jit (filterbank pinv is a trace-time
+    constant)."""
+    mel = synthesize_mel(params, config, chars)
+    filterbank = mel_filterbank(
+        sample_rate=config.sample_rate, n_fft=config.n_fft,
+        n_mels=config.n_mels)                            # (n_mels, bins)
+    inverse = jnp.asarray(np.linalg.pinv(np.asarray(filterbank)),
+                          jnp.float32)                   # (bins, n_mels)
+    energy = jnp.exp(mel.astype(jnp.float32))            # log-mel -> mel
+    linear = jnp.maximum(
+        jnp.einsum("bmt,fm->bft", energy, inverse), 0.0)
+    magnitude = jnp.sqrt(linear + 1e-8)
+    waveform = griffin_lim(magnitude, config)
+    peak = jnp.max(jnp.abs(waveform), axis=-1, keepdims=True)
+    return (waveform / jnp.maximum(peak, 1e-6)).astype(jnp.float32)

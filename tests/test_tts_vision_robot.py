@@ -1,0 +1,281 @@
+# TTS / classical vision / robot seat tests (VERDICT round-1 items 7 +
+# missing #6): the Coqui-seat TextToSpeech chain, face + ArUco detectors
+# with the overlay contract, and the simulated robot actor driven by
+# (action ...) commands.
+
+import queue
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models.tts import (
+    TTSConfig, encode_chars, init_tts_params, synthesize, synthesize_mel)
+
+
+class TestTTS:
+    CONFIG = TTSConfig(d_model=64, n_conv_layers=2, n_mels=40, n_fft=256,
+                       hop=128, frames_per_char=4, griffin_lim_iters=8)
+
+    def test_mel_shapes(self):
+        params = init_tts_params(self.CONFIG, jax.random.PRNGKey(0))
+        chars = encode_chars("hello world", max_len=16)
+        mel = synthesize_mel(params, self.CONFIG, jnp.asarray(chars))
+        assert mel.shape == (1, 40, 16 * 4)
+
+    def test_waveform_end_to_end(self):
+        params = init_tts_params(self.CONFIG, jax.random.PRNGKey(0))
+        chars = encode_chars("aloha honua", max_len=16)
+        waveform = synthesize(params, self.CONFIG, jnp.asarray(chars))
+        samples = (16 * 4 - 1) * 128 + 256
+        assert waveform.shape == (1, samples)
+        wave = np.asarray(waveform)
+        assert np.isfinite(wave).all()
+        assert np.abs(wave).max() <= 1.0 + 1e-5
+        assert np.abs(wave).max() > 1e-3  # actually produced signal
+
+    def test_deterministic(self):
+        params = init_tts_params(self.CONFIG, jax.random.PRNGKey(0))
+        chars = jnp.asarray(encode_chars("abc", max_len=8))
+        a = np.asarray(synthesize(params, self.CONFIG, chars))
+        b = np.asarray(synthesize(params, self.CONFIG, chars))
+        np.testing.assert_array_equal(a, b)
+
+    def test_element_in_pipeline(self):
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.pipeline import create_pipeline
+        definition = {
+            "name": "tts_pipe",
+            "graph": ["(text (speak))"],
+            "elements": [
+                {"name": "text", "output": [{"name": "text"}],
+                 "parameters": {"data_sources": ["hello"]},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "TextSource"}}},
+                {"name": "speak", "input": [{"name": "text"}],
+                 "output": [{"name": "audio"},
+                            {"name": "sample_rate"}],
+                 "parameters": {"d_model": 64, "n_conv_layers": 2,
+                                "frames_per_char": 4,
+                                "griffin_lim_iters": 4,
+                                "max_chars": 16},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "TextToSpeech"}}},
+            ],
+        }
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, definition)
+        process.run(in_thread=True)
+        responses = queue.Queue()
+        pipeline.create_stream("s1", queue_response=responses)
+        _, _, outputs = responses.get(timeout=60)
+        audio = np.asarray(outputs["audio"])
+        assert audio.ndim == 2 and audio.shape[1] > 1000
+        assert outputs["sample_rate"] == 16000
+        assert np.isfinite(audio).all()
+        process.terminate()
+
+
+class TestVision:
+    def test_aruco_detects_rendered_marker(self):
+        cv2 = pytest.importorskip("cv2")
+        from aiko_services_tpu.elements.vision import ArucoDetect
+        dictionary = cv2.aruco.getPredefinedDictionary(
+            cv2.aruco.DICT_4X4_50)
+        marker = cv2.aruco.generateImageMarker(dictionary, 7, 120)
+        canvas = np.full((300, 300), 255, np.uint8)
+        canvas[90:210, 90:210] = marker
+        element = ArucoDetect.__new__(ArucoDetect)
+        element._detector = None
+        element.get_parameter = (
+            lambda name, default=None, stream=None: default)
+        _, outputs = ArucoDetect.process_frame(element, None, canvas)
+        assert outputs["markers"]["ids"] == [7]
+        detections = outputs["detections"]
+        assert bool(detections["valid"][0])
+        assert int(detections["classes"][0]) == 7
+        x0, y0, x1, y1 = detections["boxes"][0]
+        assert 80 <= x0 <= 100 and 200 <= x1 <= 220
+        assert outputs["overlay"]["objects"][0]["name"] == "aruco_7"
+
+    def test_aruco_no_markers(self):
+        pytest.importorskip("cv2")
+        from aiko_services_tpu.elements.vision import ArucoDetect
+        element = ArucoDetect.__new__(ArucoDetect)
+        element._detector = None
+        element.get_parameter = (
+            lambda name, default=None, stream=None: default)
+        _, outputs = ArucoDetect.process_frame(
+            element, None, np.zeros((64, 64), np.uint8))
+        assert outputs["markers"]["ids"] == []
+        assert not outputs["detections"]["valid"].any()
+
+    @staticmethod
+    def _face_element():
+        from aiko_services_tpu.elements.vision import FaceDetect
+        element = FaceDetect.__new__(FaceDetect)
+        element._cascade = None
+        element.get_parameter = (
+            lambda name, default=None, stream=None: default)
+        return element
+
+    @staticmethod
+    def _face_image():
+        """Skin-tone ellipse (a face-shaped blob) on a blue background,
+        CHW float -- the Detector-side image convention."""
+        height, width = 120, 160
+        yy, xx = np.mgrid[0:height, 0:width]
+        ellipse = (((yy - 60) / 35.0) ** 2
+                   + ((xx - 80) / 25.0) ** 2) <= 1.0
+        image = np.zeros((height, width, 3), np.float32)
+        image[...] = (0.1, 0.2, 0.8)                   # background
+        image[ellipse] = (224 / 255, 160 / 255, 130 / 255)  # skin
+        return image.transpose(2, 0, 1)
+
+    def test_face_detect_finds_skin_ellipse(self):
+        from aiko_services_tpu.elements.vision import FaceDetect
+        element = self._face_element()
+        _, outputs = FaceDetect.process_frame(
+            element, None, self._face_image())
+        objects = outputs["overlay"]["objects"]
+        assert len(objects) == 1 and objects[0]["name"] == "face"
+        rect = outputs["overlay"]["rectangles"][0]
+        # ellipse bbox ~ x:[55,105], y:[25,95]
+        assert 50 <= rect["x"] <= 60 and 20 <= rect["y"] <= 30
+        assert 44 <= rect["w"] <= 56 and 64 <= rect["h"] <= 76
+        detections = outputs["detections"]
+        assert bool(detections["valid"][0])
+        assert float(detections["scores"][0]) > 0.5
+
+    def test_face_detect_rejects_non_face_shapes(self):
+        # a thin skin-colored bar fails the aspect/fill face gates
+        from aiko_services_tpu.elements.vision import FaceDetect
+        element = self._face_element()
+        image = np.zeros((120, 160, 3), np.float32)
+        image[...] = (0.1, 0.2, 0.8)
+        image[58:62, 10:150] = (224 / 255, 160 / 255, 130 / 255)
+        _, outputs = FaceDetect.process_frame(
+            element, None, image.transpose(2, 0, 1))
+        assert outputs["overlay"]["objects"] == []
+        assert not outputs["detections"]["valid"].any()
+
+
+class TestRobot:
+    def _start(self):
+        from aiko_services_tpu.runtime import Process, Registrar
+        from aiko_services_tpu.elements.robot import RobotActor
+        process = Process(transport_kind="loopback")
+        Registrar(process, search_timeout=0.05)
+        robot = RobotActor(process, name="xgo")
+        process.run(in_thread=True)
+        return process, robot
+
+    def test_actions_update_kinematics_and_share(self):
+        process, robot = self._start()
+        try:
+            robot.action("move", 1.0)
+            robot.action("turn", 90)
+            robot.action("move", 2.0)
+            robot.action("pose", "sit")
+            assert robot.share["x"] == pytest.approx(1.0)
+            assert robot.share["y"] == pytest.approx(2.0)
+            assert robot.share["heading"] == 90.0
+            assert robot.share["odometer"] == pytest.approx(3.0)
+            assert robot.share["pose"] == "sit"
+            assert robot.share["actions"] == 4
+        finally:
+            process.terminate()
+
+    def test_unknown_action_is_ignored(self):
+        process, robot = self._start()
+        try:
+            robot.action("self_destruct")
+            assert robot.share["actions"] == 0
+        finally:
+            process.terminate()
+
+    def test_remote_action_via_proxy(self):
+        from aiko_services_tpu.runtime.proxy import make_proxy
+        from aiko_services_tpu.transport.loopback import get_broker
+        process, robot = self._start()
+        try:
+            proxy = make_proxy(process, robot.topic_path)
+            proxy.action("move", 0.5)
+            deadline = time.monotonic() + 5
+            while (robot.share["actions"] == 0
+                   and time.monotonic() < deadline):
+                get_broker().drain()
+                time.sleep(0.01)
+            assert robot.share["x"] == pytest.approx(0.5)
+        finally:
+            process.terminate()
+
+    def test_parse_actions_grammar(self):
+        from aiko_services_tpu.elements.robot import parse_actions
+        text = ("Sure! I'll do that: (action move 0.5) then "
+                "(action turn 45) and finally (action stop)")
+        assert parse_actions(text) == [
+            ("move", ["0.5"]), ("turn", ["45"]), ("stop", [])]
+        assert parse_actions("no actions here") == []
+        assert parse_actions("") == []
+
+    def test_robot_control_dispatches_to_discovered_robot(self):
+        from aiko_services_tpu.pipeline import create_pipeline
+        from aiko_services_tpu.transport.loopback import get_broker
+        process, robot = self._start()
+        definition = {
+            "name": "robot_pipe",
+            "graph": ["(control)"],
+            "elements": [
+                {"name": "control", "input": [{"name": "text"}],
+                 "output": [{"name": "actions"},
+                            {"name": "dispatched"}],
+                 "parameters": {"robot_topic": None},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "RobotControl"}}},
+            ],
+        }
+        definition["elements"][0]["parameters"] = {
+            "robot_topic": robot.topic_path}
+        pipeline = create_pipeline(process, definition)
+        try:
+            responses = queue.Queue()
+            pipeline.create_stream("s1", queue_response=responses)
+            pipeline.process_frame(
+                {"stream_id": "s1", "frame_id": 0},
+                {"text": "(action move 2.0) (action turn 180)"})
+            _, _, outputs = responses.get(timeout=5)
+            assert outputs["dispatched"] == 2
+            assert outputs["actions"] == [["move", "2.0"],
+                                          ["turn", "180"]]
+            deadline = time.monotonic() + 5
+            while (robot.share["actions"] < 2
+                   and time.monotonic() < deadline):
+                get_broker().drain()
+                time.sleep(0.01)
+            assert robot.share["x"] == pytest.approx(2.0)
+            assert robot.share["heading"] == 180.0
+        finally:
+            process.terminate()
+
+
+class TestTTSWeights:
+    def test_save_load_pytree_roundtrip(self, tmp_path):
+        """TTS params must round-trip through the shared checkpoint
+        machinery (stacked conv layers, no Python-list leaves)."""
+        from aiko_services_tpu.models.weights import (
+            load_pytree, save_pytree)
+        config = TestTTS.CONFIG
+        params = init_tts_params(config, jax.random.PRNGKey(0))
+        path = tmp_path / "tts.npz"
+        save_pytree(str(path), params)
+        restored = load_pytree(str(path))
+        chars = jnp.asarray(encode_chars("roundtrip", max_len=16))
+        want = np.asarray(synthesize(params, config, chars))
+        got = np.asarray(synthesize(restored, config, chars))
+        np.testing.assert_allclose(got, want, atol=1e-6)
