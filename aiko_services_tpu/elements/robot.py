@@ -56,13 +56,20 @@ class RobotActor(Actor):
     # -- the wire command ----------------------------------------------
 
     def action(self, name, *args):
-        """(action <name> <args...>) -- validate against the action
-        vocabulary and apply; unknown actions are logged, not fatal
-        (the LM may hallucinate)."""
+        """(action <name> <args...>) -- validate name AND argument types
+        against the action vocabulary before touching any state; invalid
+        actions are logged, not fatal (the LM may hallucinate)."""
         name = str(name)
         if name not in ACTIONS:
             _LOGGER.warning("%s: unknown action: %s", self.name, name)
             return
+        if name in ("move", "turn") and args:
+            try:
+                args = (float(args[0]),)
+            except (TypeError, ValueError):
+                _LOGGER.warning("%s: bad %s argument: %r", self.name,
+                                name, args[0])
+                return
         self.history.append((name, args, time.time()))
         self._apply(name, args)
         self._update_share("actions", int(self.share["actions"]) + 1)
@@ -128,12 +135,19 @@ class RobotControl(PipelineElement):
     ("robot_service" name).  Emits the parsed actions so graphs can also
     fan them into recorders/dashboards."""
 
+    _proxy_cache: tuple | None = None  # (resolution key, proxy)
+
     def _robot_proxy(self, stream):
         from ..runtime.proxy import make_proxy
         target = self.get_parameter("robot_topic", None, stream)
-        if target:
-            return make_proxy(self.process, str(target))
         name = self.get_parameter("robot_service", None, stream)
+        key = (target, name)
+        if self._proxy_cache is not None and self._proxy_cache[0] == key:
+            return self._proxy_cache[1]
+        if target:
+            proxy = make_proxy(self.process, str(target))
+            self._proxy_cache = (key, proxy)
+            return proxy
         if not name:
             return None
         from ..runtime import ServiceFilter
@@ -142,10 +156,13 @@ class RobotControl(PipelineElement):
         matches = list(cache.services.filter_services(
             ServiceFilter(name=str(name))))
         if not matches:
+            # not cached: retry discovery on the next frame
             _LOGGER.warning("%s: robot service '%s' not discovered yet",
                             self.definition.name, name)
             return None
-        return make_proxy(self.process, matches[0].topic_path)
+        proxy = make_proxy(self.process, matches[0].topic_path)
+        self._proxy_cache = (key, proxy)
+        return proxy
 
     def process_frame(self, stream, text):
         prompts = [text] if isinstance(text, str) else list(text)

@@ -103,7 +103,14 @@ class FaceDetect(PipelineElement):
     _cascade = None
 
     def _detect_classical(self, image, stream):
-        from scipy import ndimage
+        try:
+            from scipy import ndimage
+        except ImportError as error:
+            raise RuntimeError(
+                "scipy is required for the built-in face detector "
+                "(pip install aiko_services_tpu[media]); alternatively "
+                "set the 'cascade' parameter on an OpenCV build that "
+                "ships CascadeClassifier") from error
         rgb = _to_rgb_float(image)
         mask = _skin_mask(rgb)
         labels, count = ndimage.label(mask)
@@ -111,10 +118,13 @@ class FaceDetect(PipelineElement):
         min_area = float(self.get_parameter(
             "min_area_fraction", 0.002, stream)) * height * width
         results = []
-        for slice_y, slice_x in ndimage.find_objects(labels):
+        for index, (slice_y, slice_x) in enumerate(
+                ndimage.find_objects(labels)):
             h = slice_y.stop - slice_y.start
             w = slice_x.stop - slice_x.start
-            region = labels[slice_y, slice_x] > 0
+            # only THIS component's pixels (find_objects slices are
+            # ordered by label id); a bbox may overlap other blobs
+            region = labels[slice_y, slice_x] == index + 1
             area = int(region.sum())
             if area < min_area or h == 0 or w == 0:
                 continue
@@ -177,23 +187,28 @@ class ArucoDetect(PipelineElement):
     marker ids + corners + detections/overlay contract; optional pose
     when camera parameters are supplied."""
 
-    _detector = None
+    _detectors: dict | None = None
 
-    def _get_detector(self):
-        if self._detector is None:
-            cv2 = _require_cv2()
-            name = str(self.get_parameter("dictionary", "DICT_4X4_50"))
+    def _get_detector(self, stream):
+        cv2 = _require_cv2()
+        name = str(self.get_parameter("dictionary", "DICT_4X4_50",
+                                      stream))
+        if self._detectors is None:
+            self._detectors = {}
+        detector = self._detectors.get(name)
+        if detector is None:
             dictionary = cv2.aruco.getPredefinedDictionary(
                 getattr(cv2.aruco, name))
-            self._detector = cv2.aruco.ArucoDetector(
+            detector = cv2.aruco.ArucoDetector(
                 dictionary, cv2.aruco.DetectorParameters())
-        return self._detector
+            self._detectors[name] = detector
+        return detector
 
     def process_frame(self, stream, image):
         gray = _to_gray_uint8(image)
         max_detections = int(
             self.get_parameter("max_detections", 32, stream))
-        corners, ids, _ = self._get_detector().detectMarkers(gray)
+        corners, ids, _ = self._get_detector(stream).detectMarkers(gray)
         boxes, classes, objects, rectangles = [], [], [], []
         marker_corners = []
         if ids is not None:

@@ -1,0 +1,229 @@
+# MQTT transport contract tests (VERDICT round-1 item 9: transport/
+# mqtt.py had never executed -- paho is absent from the TPU image).
+#
+# A fake paho module (tests/fake_paho.py) is injected as
+# transport.mqtt._paho, and the SAME behavioral contract is asserted
+# against both LoopbackTransport and MqttTransport: pub/sub roundtrip,
+# wildcard collapse, retained delivery, LWT on abnormal loss vs clean
+# disconnect, and the LWT-change reconnect cycle (reference mqtt.py:
+# 192-228 semantics).  A final test boots a full Process + Registrar
+# stack over the MQTT transport.
+
+import time
+
+import pytest
+
+import fake_paho
+from aiko_services_tpu.transport import loopback as loopback_module
+from aiko_services_tpu.transport import mqtt as mqtt_module
+from aiko_services_tpu.transport.loopback import LoopbackTransport
+
+
+@pytest.fixture(autouse=True)
+def fake_broker(monkeypatch):
+    fake_paho.FakeMqttBroker.reset_all()
+    monkeypatch.setattr(mqtt_module, "_paho", fake_paho)
+    monkeypatch.setattr(mqtt_module, "_PAHO_ERROR", None)
+    loopback_module.reset_brokers()
+    yield
+    fake_paho.FakeMqttBroker.reset_all()
+    loopback_module.reset_brokers()
+
+
+def make_transport(kind, on_message):
+    if kind == "loopback":
+        transport = LoopbackTransport(on_message)
+    else:
+        transport = mqtt_module.MqttTransport(
+            on_message,
+            configuration={"host": "fakehost", "port": 1883,
+                           "username": None, "password": None,
+                           "tls": False})
+    return transport
+
+
+def drain(kind):
+    if kind == "loopback":
+        loopback_module.get_broker().drain()
+    # fake paho delivers synchronously
+
+
+KINDS = ["loopback", "mqtt"]
+
+
+@pytest.mark.parametrize("kind", KINDS)
+class TestTransportContract:
+    def test_pubsub_roundtrip(self, kind):
+        received = []
+        transport = make_transport(
+            kind, lambda topic, payload: received.append((topic, payload)))
+        transport.connect()
+        transport.subscribe("ns/host/1/in")
+        transport.publish("ns/host/1/in", "(hello world)")
+        drain(kind)
+        assert received == [("ns/host/1/in", "(hello world)")]
+        transport.disconnect()
+
+    def test_wildcard_collapse(self, kind):
+        """A # subscription must receive everything a concrete
+        subscription would -- and only matching topics."""
+        received = []
+        transport = make_transport(
+            kind, lambda topic, payload: received.append(topic))
+        transport.connect()
+        transport.subscribe("ns/+/state")
+        transport.subscribe("ns/deep/#")
+        transport.publish("ns/alpha/state", "x")     # matches +
+        transport.publish("ns/alpha/other", "x")     # matches neither
+        transport.publish("ns/deep/a/b/c", "x")      # matches #
+        drain(kind)
+        assert sorted(received) == ["ns/alpha/state", "ns/deep/a/b/c"]
+        transport.disconnect()
+
+    def test_retained_delivered_on_late_subscribe(self, kind):
+        received = []
+        publisher = make_transport(kind, None)
+        publisher.connect()
+        publisher.publish("ns/service/registrar", "(primary found x)",
+                          retain=True)
+        drain(kind)
+        subscriber = make_transport(
+            kind, lambda topic, payload: received.append(payload))
+        subscriber.connect()
+        subscriber.subscribe("ns/service/registrar")
+        drain(kind)
+        assert received == ["(primary found x)"]
+        publisher.disconnect()
+        subscriber.disconnect()
+
+    def test_retained_cleared_by_empty_payload(self, kind):
+        received = []
+        publisher = make_transport(kind, None)
+        publisher.connect()
+        publisher.publish("ns/boot", "stale", retain=True)
+        publisher.publish("ns/boot", "", retain=True)
+        drain(kind)
+        subscriber = make_transport(
+            kind, lambda topic, payload: received.append(payload))
+        subscriber.connect()
+        subscriber.subscribe("ns/boot")
+        drain(kind)
+        assert received == []
+        publisher.disconnect()
+        subscriber.disconnect()
+
+    def test_no_lwt_on_clean_disconnect(self, kind):
+        received = []
+        watcher = make_transport(
+            kind, lambda topic, payload: received.append(payload))
+        watcher.connect()
+        watcher.subscribe("ns/x/state")
+        client = make_transport(kind, None)
+        client.set_last_will_and_testament("ns/x/state", "(absent)")
+        client.connect()
+        client.disconnect()           # clean: no will
+        drain(kind)
+        assert received == []
+        watcher.disconnect()
+
+
+class TestMqttSpecifics:
+    """Behaviors only observable against the fake paho broker."""
+
+    def _pair(self):
+        received = []
+        watcher = make_transport(
+            "mqtt", lambda topic, payload: received.append(
+                (topic, payload)))
+        watcher.connect()
+        return watcher, received
+
+    def test_lwt_fires_on_abnormal_drop(self):
+        watcher, received = self._pair()
+        watcher.subscribe("ns/+/+/+/state")
+        client = make_transport("mqtt", None)
+        client.set_last_will_and_testament(
+            "ns/host/9/0/state", "(absent)", retain=True)
+        client.connect()
+        broker = fake_paho.FakeMqttBroker.get("fakehost", 1883)
+        broker.drop(client._client)   # socket loss, not disconnect()
+        assert ("ns/host/9/0/state", "(absent)") in received
+        # retained for late registrars
+        assert broker.retained["ns/host/9/0/state"] == b"(absent)"
+        watcher.disconnect()
+
+    def test_lwt_change_cycles_connection(self):
+        """Changing the LWT must disconnect/reconnect (MQTT protocol:
+        one will per connection, set at CONNECT -- reference
+        mqtt.py:192-201) and resubscribe existing patterns."""
+        watcher, received = self._pair()
+        watcher.subscribe("ns/#")
+        client = make_transport("mqtt", None)
+        client.set_last_will_and_testament("ns/a/state", "(absent a)")
+        client.connect()
+        client.subscribe("ns/control")
+        client.set_last_will_and_testament("ns/b/state", "(absent b)")
+        # reconnect cycle happened; subscriptions survived
+        assert client.connected
+        client.publish("ns/ping", "x")
+        broker = fake_paho.FakeMqttBroker.get("fakehost", 1883)
+        broker.drop(client._client)
+        assert ("ns/b/state", "(absent b)") in received
+        assert ("ns/a/state", "(absent a)") not in received
+        watcher.disconnect()
+
+    def test_clear_lwt_cycles_and_disarms(self):
+        watcher, received = self._pair()
+        watcher.subscribe("ns/#")
+        client = make_transport("mqtt", None)
+        client.set_last_will_and_testament("ns/c/state", "(absent)")
+        client.connect()
+        client.clear_last_will_and_testament("ns/c/state")
+        broker = fake_paho.FakeMqttBroker.get("fakehost", 1883)
+        broker.drop(client._client)
+        assert received == []
+        watcher.disconnect()
+
+    def test_reconnect_resubscribes(self):
+        received = []
+        client = make_transport(
+            "mqtt", lambda topic, payload: received.append(payload))
+        client.subscribe("ns/data")   # subscribed before connect
+        client.connect()
+        client.disconnect()
+        client.connect()              # patterns replayed on_connect
+        client.publish("ns/data", "after-reconnect")
+        assert received == ["after-reconnect"]
+        client.disconnect()
+
+
+class TestProcessOverMqtt:
+    def test_registrar_handshake_over_mqtt_transport(self, monkeypatch):
+        """The full runtime stack (Process + Registrar + actor
+        registration) over MqttTransport/fake paho -- the reference
+        deployment topology, never executable in this image before."""
+        monkeypatch.setenv("AIKO_MQTT_HOST", "fakehost")
+        monkeypatch.setenv("AIKO_MQTT_PORT", "1883")
+        from aiko_services_tpu.runtime import (
+            ConnectionState, Process, Registrar)
+
+        registrar_process = Process(transport_kind="mqtt")
+        registrar = Registrar(registrar_process, search_timeout=0.05)
+        registrar_process.run(in_thread=True)
+
+        worker = Process(transport_kind="mqtt")
+        from aiko_services_tpu.runtime.actor import Actor
+        actor = Actor(worker, name="mqtt_actor")
+        worker.run(in_thread=True)
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (worker.connection.is_connected(ConnectionState.REGISTRAR)
+                    and registrar.services_table.get_service(
+                        actor.topic_path)):
+                break
+            time.sleep(0.02)
+        fields = registrar.services_table.get_service(actor.topic_path)
+        assert fields is not None and fields.name == "mqtt_actor"
+        worker.terminate()
+        registrar_process.terminate()

@@ -279,3 +279,54 @@ class TestTTSWeights:
         want = np.asarray(synthesize(params, config, chars))
         got = np.asarray(synthesize(restored, config, chars))
         np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+class TestReviewHardening:
+    def test_overlapping_bbox_does_not_inflate_other_component(self):
+        """A thin bar whose bbox overlaps the face blob must still be
+        rejected: area/fill are computed per component, not per bbox."""
+        from aiko_services_tpu.elements.vision import FaceDetect
+        element = TestVision._face_element()
+        image = np.zeros((120, 160, 3), np.float32)
+        image[...] = (0.1, 0.2, 0.8)
+        skin = (224 / 255, 160 / 255, 130 / 255)
+        yy, xx = np.mgrid[0:120, 0:160]
+        ellipse = (((yy - 60) / 30.0) ** 2 + ((xx - 60) / 22.0) ** 2) <= 1
+        image[ellipse] = skin
+        image[10:13, 30:150] = skin   # bar overlapping the face's columns
+        _, outputs = FaceDetect.process_frame(
+            element, None, image.transpose(2, 0, 1))
+        names = [o["name"] for o in outputs["overlay"]["objects"]]
+        assert names == ["face"]      # bar rejected, face kept
+
+    def test_robot_bad_argument_is_rejected_without_state_change(self):
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.elements.robot import RobotActor
+        process = Process(transport_kind="loopback")
+        robot = RobotActor(process, name="xgo2")
+        process.run(in_thread=True)
+        try:
+            robot.action("move", "forward")   # LM hallucinated arg
+            assert robot.share["actions"] == 0
+            assert robot.history == []
+            robot.action("move", "1.25")      # numeric string is fine
+            assert robot.share["x"] == pytest.approx(1.25)
+        finally:
+            process.terminate()
+
+    def test_aruco_dictionary_parameter_is_stream_scoped(self):
+        cv2 = pytest.importorskip("cv2")
+        from aiko_services_tpu.elements.vision import ArucoDetect
+        dictionary = cv2.aruco.getPredefinedDictionary(
+            cv2.aruco.DICT_6X6_250)
+        marker = cv2.aruco.generateImageMarker(dictionary, 11, 120)
+        canvas = np.full((300, 300), 255, np.uint8)
+        canvas[90:210, 90:210] = marker
+        element = ArucoDetect.__new__(ArucoDetect)
+        element._detectors = None
+        params = {"dictionary": "DICT_6X6_250"}
+        element.get_parameter = (
+            lambda name, default=None, stream=None:
+            params.get(name, default))
+        _, outputs = ArucoDetect.process_frame(element, None, canvas)
+        assert outputs["markers"]["ids"] == [11]
