@@ -17,7 +17,8 @@ from .image_io import (                                       # noqa: F401
     ImageReadFile, ImageSource, ImageResize, ImageOverlay, ImageWriteFile,
     ImageOutput)
 from .audio_io import (                                       # noqa: F401
-    AudioReadFile, AudioWriteFile, ToneSource, AudioFraming, AudioSample)
+    AudioReadFile, AudioWriteFile, ToneSource, AudioFraming, AudioSample,
+    AudioFFT, AudioResample)
 from .video_io import (                                       # noqa: F401
     VideoReadFile, VideoSample, VideoWriteFile, VideoOutput)
 from .webcam_io import VideoReadWebcam                        # noqa: F401

@@ -17,7 +17,8 @@ from ..utils import get_logger
 from .common_io import DataSource, DataTarget, Sample
 
 __all__ = ["AudioReadFile", "AudioWriteFile", "ToneSource", "AudioFraming",
-           "AudioSample", "synthesize_tone", "SAMPLE_RATE"]
+           "AudioSample", "AudioFFT", "AudioResample", "synthesize_tone",
+           "SAMPLE_RATE"]
 
 _LOGGER = get_logger("audio_io")
 SAMPLE_RATE = 16000  # reference audio_io.py:455-460: 16 kHz
@@ -96,3 +97,52 @@ class AudioFraming(PipelineElement):
 
 class AudioSample(Sample):
     """Drop-frame sampler over audio (shared Sample base)."""
+
+
+class AudioFFT(PipelineElement):
+    """Magnitude spectrum of an audio frame on device (the reference's
+    disabled PE_FFT seat, audio_io.py:196-640): audio (samples,) or
+    (B, samples) -> {"spectrum": |rfft|, "frequencies": bin centers}.
+    Runs as jnp.fft on the element's device -- XLA, not numpy."""
+
+    def process_frame(self, stream, audio):
+        import jax.numpy as jnp
+        sample_rate = int(self.get_parameter("sample_rate", SAMPLE_RATE,
+                                             stream))
+        waveform = jnp.asarray(np.asarray(audio), jnp.float32)
+        spectrum = jnp.abs(jnp.fft.rfft(waveform, axis=-1))
+        frequencies = np.fft.rfftfreq(waveform.shape[-1],
+                                      1.0 / sample_rate)
+        return StreamEvent.OKAY, {"spectrum": spectrum,
+                                  "frequencies": frequencies}
+
+
+class AudioResample(PipelineElement):
+    """Sample-rate conversion (the reference's disabled PE_AudioResampler
+    seat): linear interpolation via jnp.interp on device.  Parameters:
+    rate_in (default SAMPLE_RATE), rate_out (required)."""
+
+    def process_frame(self, stream, audio):
+        import jax
+        import jax.numpy as jnp
+        rate_in = int(self.get_parameter("rate_in", SAMPLE_RATE, stream))
+        rate_out = int(self.get_parameter("rate_out", SAMPLE_RATE,
+                                          stream))
+        waveform = jnp.asarray(np.asarray(audio), jnp.float32)
+        if rate_in == rate_out:
+            return StreamEvent.OKAY, {"audio": waveform,
+                                      "sample_rate": rate_out}
+        # resample along the LAST axis only; leading batch/channel axes
+        # are preserved (never interpolate across row boundaries)
+        samples = waveform.shape[-1]
+        lead_shape = waveform.shape[:-1]
+        rows = waveform.reshape(-1, samples)
+        out_samples = int(round(samples * rate_out / rate_in))
+        positions = (jnp.arange(out_samples, dtype=jnp.float32)
+                     * (rate_in / rate_out))
+        source = jnp.arange(samples, dtype=jnp.float32)
+        resampled = jax.vmap(
+            lambda row: jnp.interp(positions, source, row))(rows)
+        resampled = resampled.reshape(*lead_shape, out_samples)
+        return StreamEvent.OKAY, {"audio": resampled,
+                                  "sample_rate": rate_out}

@@ -8,7 +8,9 @@ from .service import (                                        # noqa: F401
     SERVICE_PROTOCOL_ACTOR)
 from .process import Process, default_process                 # noqa: F401
 from .actor import Actor, ActorMessage, ActorTopic            # noqa: F401
-from .proxy import make_proxy, get_public_methods, RemoteProxy  # noqa: F401
+from .proxy import (                                        # noqa: F401
+    make_proxy, get_public_methods, RemoteProxy, TracingProxy,
+    trace_all_methods)
 from .share import (                                          # noqa: F401
     ECProducer, ECConsumer, ServicesCache,
     services_cache_create_singleton)

@@ -4,7 +4,9 @@ from .sexpr import (                                        # noqa: F401
 from .graph import Graph, Node, GraphError                  # noqa: F401
 from .config import (                                       # noqa: F401
     get_namespace, get_hostname, get_pid, get_transport_configuration,
-    get_mqtt_configuration, get_bool_env)
+    get_mqtt_configuration, get_bool_env, probe_tcp, get_mqtt_host,
+    BootstrapResponder)
+from .lock import DiagnosticLock                            # noqa: F401
 from .lru_cache import LRUCache                             # noqa: F401
 from .timeutil import (                                     # noqa: F401
     epoch_now, epoch_to_iso, iso_to_epoch, monotonic)

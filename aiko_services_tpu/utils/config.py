@@ -14,10 +14,12 @@ import socket
 
 __all__ = [
     "get_namespace", "get_hostname", "get_pid", "get_transport_configuration",
-    "get_mqtt_configuration", "get_bool_env",
+    "get_mqtt_configuration", "get_bool_env", "probe_tcp", "get_mqtt_host",
+    "BootstrapResponder",
 ]
 
 DEFAULT_NAMESPACE = "aiko"
+BOOTSTRAP_PORT = 4149  # reference configuration.py:168 (UDP MCU bootstrap)
 
 
 def get_namespace() -> str:
@@ -52,6 +54,86 @@ def get_mqtt_configuration() -> dict:
         "password": os.environ.get("AIKO_PASSWORD"),
         "tls": get_bool_env("AIKO_MQTT_TLS"),
     }
+
+
+def probe_tcp(host: str, port: int, timeout: float = 0.5) -> bool:
+    """True when a TCP connect to host:port succeeds within timeout (the
+    reference's broker-reachability probe, configuration.py:121-139)."""
+    try:
+        with socket.create_connection((host, int(port)), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def get_mqtt_host(candidates: list | None = None,
+                  port: int | None = None,
+                  timeout: float = 0.5) -> str | None:
+    """First REACHABLE broker host: AIKO_MQTT_HOST, then the comma list
+    AIKO_MQTT_HOSTS, then localhost -- each verified with a TCP connect
+    probe (reference configuration.py:121-139).  None when nothing
+    answers (callers fall back to the loopback broker)."""
+    if port is None:
+        port = int(os.environ.get("AIKO_MQTT_PORT", "1883"))
+    if candidates is None:
+        candidates = []
+        primary = os.environ.get("AIKO_MQTT_HOST")
+        if primary:
+            candidates.append(primary)
+        extra = os.environ.get("AIKO_MQTT_HOSTS", "")
+        candidates += [h.strip() for h in extra.split(",") if h.strip()]
+        candidates.append("localhost")
+    for host in candidates:
+        if probe_tcp(host, port, timeout):
+            return host
+    return None
+
+
+class BootstrapResponder:
+    """UDP bootstrap responder for MCU-class devices (reference
+    configuration.py:168-186): microcontrollers that cannot run discovery
+    broadcast a datagram on BOOTSTRAP_PORT and receive the namespace +
+    broker endpoint back, e.g. b"boot?" -> b"(boot aiko localhost 1883)".
+    """
+
+    def __init__(self, port: int = BOOTSTRAP_PORT,
+                 mqtt_host: str | None = None, mqtt_port: int | None = None):
+        import threading
+        configuration = get_mqtt_configuration()
+        self.mqtt_host = mqtt_host or configuration["host"]
+        self.mqtt_port = int(mqtt_port or configuration["port"])
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # no SO_REUSEADDR: a second responder on the port must fail
+        # loudly (EADDRINUSE), not silently split datagram delivery
+        self._sock.bind(("0.0.0.0", int(port)))
+        self._sock.settimeout(1.0)
+        self.port = self._sock.getsockname()[1]
+        self._alive = True
+        self._thread = threading.Thread(
+            target=self._serve, name="aiko_bootstrap", daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while self._alive:
+            try:
+                _, address = self._sock.recvfrom(512)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            reply = (f"(boot {get_namespace()} {self.mqtt_host} "
+                     f"{self.mqtt_port})")
+            try:
+                self._sock.sendto(reply.encode("utf-8"), address)
+            except OSError:
+                pass
+
+    def close(self):
+        self._alive = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 def get_transport_configuration() -> dict:
