@@ -30,6 +30,32 @@ def synthesize_tone(frequency: float, seconds: float,
     return np.sin(2 * np.pi * frequency * t).astype(np.float32)
 
 
+_DEVICE_TONE = None  # lazily-built module-level jit (stable identity)
+
+
+def synthesize_tone_on_device(frequency: float, seconds: float,
+                              sample_rate: int = SAMPLE_RATE):
+    """Tone synthesized directly in HBM as ONE device program (a single
+    dispatch -- eager op-by-op jnp would pay per-op dispatch latency,
+    which dominates on tunneled/remote devices)."""
+    global _DEVICE_TONE
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    if _DEVICE_TONE is None:
+        @functools.partial(jax.jit,
+                           static_argnames=("samples", "sample_rate"))
+        def _tone(frequency, samples, sample_rate):
+            t = jnp.arange(samples) / sample_rate
+            return jnp.sin(2 * jnp.pi * frequency * t)
+
+        _DEVICE_TONE = _tone
+    return _DEVICE_TONE(jnp.float32(frequency),
+                        int(seconds * sample_rate), sample_rate)
+
+
 class AudioReadFile(DataSource):
     """data_sources of .wav paths -> {"audio": (samples,) f32 [-1, 1]}.
     Stdlib wave + numpy; 16-bit PCM mono/stereo (stereo is averaged)."""
@@ -73,10 +99,8 @@ class ToneSource(DataSource):
 
     def read_item(self, stream, item) -> dict:
         if self.get_parameter("on_device", False, stream):
-            import jax.numpy as jnp
-            t = (jnp.arange(int(float(item[1]) * SAMPLE_RATE))
-                 / SAMPLE_RATE)
-            return {"audio": jnp.sin(2 * jnp.pi * float(item[0]) * t)}
+            return {"audio": synthesize_tone_on_device(
+                float(item[0]), float(item[1]))}
         return {"audio": synthesize_tone(float(item[0]), float(item[1]))}
 
 
@@ -107,9 +131,10 @@ class AudioFFT(PipelineElement):
 
     def process_frame(self, stream, audio):
         import jax.numpy as jnp
+        from ..ops.device import as_device_array
         sample_rate = int(self.get_parameter("sample_rate", SAMPLE_RATE,
                                              stream))
-        waveform = jnp.asarray(np.asarray(audio), jnp.float32)
+        waveform = as_device_array(audio, jnp.float32)
         spectrum = jnp.abs(jnp.fft.rfft(waveform, axis=-1))
         frequencies = np.fft.rfftfreq(waveform.shape[-1],
                                       1.0 / sample_rate)
@@ -126,9 +151,13 @@ class AudioResample(PipelineElement):
         import jax
         import jax.numpy as jnp
         rate_in = int(self.get_parameter("rate_in", SAMPLE_RATE, stream))
-        rate_out = int(self.get_parameter("rate_out", SAMPLE_RATE,
-                                          stream))
-        waveform = jnp.asarray(np.asarray(audio), jnp.float32)
+        rate_out = self.get_parameter("rate_out", None, stream)
+        if rate_out is None:
+            raise ValueError(
+                f"{self.definition.name}: rate_out parameter is required")
+        rate_out = int(rate_out)
+        from ..ops.device import as_device_array
+        waveform = as_device_array(audio, jnp.float32)
         if rate_in == rate_out:
             return StreamEvent.OKAY, {"audio": waveform,
                                       "sample_rate": rate_out}

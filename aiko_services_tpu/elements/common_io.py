@@ -127,9 +127,19 @@ class DataSource(PipelineElement):
             frame_data = {}
             for key in parts[0]:
                 values = [part[key] for part in parts]
-                frame_data[key] = (np.stack(values)
-                                   if isinstance(values[0], np.ndarray)
-                                   else values)
+                if isinstance(values[0], np.ndarray):
+                    frame_data[key] = np.stack(values)
+                else:
+                    try:  # device arrays stack ON DEVICE (jnp.stack) --
+                        # never a host round-trip for on_device sources
+                        import jax
+                        import jax.numpy as jnp
+                        if isinstance(values[0], jax.Array):
+                            frame_data[key] = jnp.stack(values)
+                        else:
+                            frame_data[key] = values
+                    except ImportError:  # pragma: no cover
+                        frame_data[key] = values
         if self.get_parameter("timestamps", False, stream):
             frame_data["t0"] = time.time()
         return frame_data

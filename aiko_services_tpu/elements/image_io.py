@@ -30,6 +30,30 @@ def synthesize_image(shape, seed: int) -> np.ndarray:
                       dtype=np.float32)
 
 
+_DEVICE_SYNTH = None  # lazily-built module-level jit (stable identity)
+
+
+def synthesize_image_on_device(shape, seed: int):
+    """Deterministic random image synthesized directly in HBM.  The seed
+    rides as a TRACED argument through a module-level jit -- one
+    compilation per shape, never per frame."""
+    global _DEVICE_SYNTH
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    if _DEVICE_SYNTH is None:
+        @functools.partial(jax.jit, static_argnames=("shape",))
+        def _synth(seed_value, shape):
+            key = jax.random.fold_in(jax.random.PRNGKey(0), seed_value)
+            return jax.random.uniform(key, shape, jnp.float32)
+
+        _DEVICE_SYNTH = _synth
+    return _DEVICE_SYNTH(jnp.uint32(seed),
+                         tuple(int(size) for size in shape))
+
+
 class ImageReadFile(DataSource):
     """data_sources of image paths -> {"image": (3, H, W) f32 [0,1]}."""
 
@@ -53,10 +77,7 @@ class ImageSource(DataSource):
         seed = (int(self.get_parameter("seed", 0, stream))
                 + self.emission_index(stream))
         if self.get_parameter("on_device", False, stream):
-            import jax
-            key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
-            shape = tuple(int(size) for size in item)
-            return {"image": jax.random.uniform(key, shape)}
+            return {"image": synthesize_image_on_device(item, seed)}
         return {"image": synthesize_image(item, seed)}
 
 

@@ -21,6 +21,7 @@ from ..models import (
     load_pytree, transcribe)
 from ..models import configs as model_configs
 from ..ops import log_mel_spectrogram
+from ..ops.device import as_device_array as _as_device_array
 from ..pipeline import ComputeElement, PipelineElement, StreamEvent
 from ..utils import get_logger
 
@@ -142,7 +143,7 @@ class LMGenerate(ComputeElement):
             tokens = np.full((len(encoded), width), pad, np.int32)
             for row, ids in enumerate(encoded):
                 tokens[row, width - len(ids):] = ids  # left-pad
-        tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
+        tokens = _as_device_array(tokens, jnp.int32)
         if bool(self.get_parameter("stream_tokens", False, stream)):
             # streamed serving path: publish token chunks to /out as they
             # decode (reference capability: Ollama token streaming)
@@ -212,7 +213,7 @@ class SpeechToText(ComputeElement):
 
     def process_frame(self, stream, audio):
         self._ensure_ready()
-        audio = jnp.asarray(np.asarray(audio), jnp.float32)
+        audio = _as_device_array(audio, jnp.float32)
         if audio.ndim == 1:
             audio = audio[None]
         max_tokens = int(self.get_parameter("max_tokens", 32, stream))
@@ -355,7 +356,7 @@ class Detector(ComputeElement):
 
     def process_frame(self, stream, image):
         self._ensure_ready()
-        image = jnp.asarray(np.asarray(image), jnp.float32)
+        image = _as_device_array(image, jnp.float32)
         if image.ndim == 3:
             image = image[None]
         detections = detect(self.state, self.config, image)

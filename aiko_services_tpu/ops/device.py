@@ -1,0 +1,18 @@
+# Device-array coercion shared by every element that takes tensor input.
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["as_device_array"]
+
+
+def as_device_array(value, dtype):
+    """Coerce an element input to a device array WITHOUT a host round-trip
+    when it is already a jax.Array (np.asarray on a device array forces a
+    device->host sync + copy -- poison for HBM-resident pipelines)."""
+    if isinstance(value, jax.Array):
+        return value.astype(dtype)
+    return jnp.asarray(np.asarray(value), dtype)

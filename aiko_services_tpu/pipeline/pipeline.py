@@ -225,7 +225,9 @@ class Pipeline(Actor):
             # pipeline.py:1229-1263)
             stream.stop_requested = True
             return
-        self.streams.pop(stream_id, None)
+        if stream.destroying:
+            return
+        stream.destroying = True
         stream.state = state
         lease = self._stream_leases.pop(stream_id, None)
         if lease is not None:
@@ -237,6 +239,10 @@ class Pipeline(Actor):
             else:
                 element.stop_frame_generation(stream_id)
                 self._safe_call(element.stop_stream, stream, stream_id)
+        # pop LAST: "stream gone from pipeline.streams" must imply the
+        # stop_stream hooks (writer close/flush) have already run --
+        # callers synchronize on stream removal
+        self.streams.pop(stream_id, None)
         self._update_stream_share()
 
     def _stream_lease_expired(self, stream_id) -> None:

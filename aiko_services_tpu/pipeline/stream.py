@@ -61,6 +61,7 @@ class Stream:
     variables: dict = field(default_factory=dict)  # per-element stream state
     pending: int = 0    # frames posted but not yet finished (backpressure)
     stop_requested: bool = False   # graceful stop: destroy when pending==0
+    destroying: bool = False       # destroy_stream in progress (reentrancy)
 
     def to_dict(self) -> dict:
         return {"stream_id": self.stream_id, "frame_id": self.frame_id}

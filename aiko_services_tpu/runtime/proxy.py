@@ -67,6 +67,9 @@ def log_trace(name: str, phase: str, elapsed: float | None,
     proxy_trace printer, proxy.py:64-72)."""
     if phase == "enter":
         _LOGGER.info("TRACE > %s%r", name, args)
+    elif phase == "error":
+        _LOGGER.info("TRACE ! %s raised %r (%.3f ms)", name, result,
+                     (elapsed or 0.0) * 1e3)
     else:
         _LOGGER.info("TRACE < %s -> %r (%.3f ms)", name, result,
                      (elapsed or 0.0) * 1e3)
@@ -85,11 +88,15 @@ class TracingProxy:
     def __init__(self, target, tracer=None):
         object.__setattr__(self, "_target", target)
         object.__setattr__(self, "_tracer", tracer or log_trace)
+        object.__setattr__(self, "_traced_cache", {})
 
     def __getattr__(self, name):
         value = getattr(self._target, name)
         if name.startswith("_") or not callable(value):
             return value
+        cached = self._traced_cache.get(name)
+        if cached is not None and cached.__wrapped__ == value:
+            return cached  # stable identity: proxy.m is proxy.m
         tracer = self._tracer
 
         def traced(*args, **kwargs):
@@ -106,6 +113,8 @@ class TracingProxy:
             return result
 
         traced.__name__ = name
+        traced.__wrapped__ = value
+        self._traced_cache[name] = traced
         return traced
 
     def __setattr__(self, name, value):

@@ -45,9 +45,18 @@ def get_bool_env(name: str, default: bool = False) -> bool:
 
 
 def get_mqtt_configuration() -> dict:
-    """MQTT endpoint settings (reference configuration.py:101-114)."""
+    """MQTT endpoint settings (reference configuration.py:101-114).
+
+    AIKO_MQTT_HOST names a broker directly (no probe -- tests and fixed
+    deployments).  Otherwise, when AIKO_MQTT_HOSTS lists candidates, the
+    first one answering a TCP connect probe wins (reference
+    configuration.py:121-139); nothing reachable falls back to
+    localhost."""
+    host = os.environ.get("AIKO_MQTT_HOST")
+    if not host and os.environ.get("AIKO_MQTT_HOSTS"):
+        host = get_mqtt_host()
     return {
-        "host": os.environ.get("AIKO_MQTT_HOST", "localhost"),
+        "host": host or "localhost",
         "port": int(os.environ.get("AIKO_MQTT_PORT", "1883")),
         "transport": os.environ.get("AIKO_MQTT_TRANSPORT", "tcp"),
         "username": os.environ.get("AIKO_USERNAME"),
@@ -99,9 +108,14 @@ class BootstrapResponder:
     def __init__(self, port: int = BOOTSTRAP_PORT,
                  mqtt_host: str | None = None, mqtt_port: int | None = None):
         import threading
-        configuration = get_mqtt_configuration()
-        self.mqtt_host = mqtt_host or configuration["host"]
-        self.mqtt_port = int(mqtt_port or configuration["port"])
+        if mqtt_host is None or mqtt_port is None:
+            # only consult (and possibly TCP-probe) the environment when
+            # the caller didn't pin the endpoint
+            configuration = get_mqtt_configuration()
+            mqtt_host = mqtt_host or configuration["host"]
+            mqtt_port = mqtt_port or configuration["port"]
+        self.mqtt_host = mqtt_host
+        self.mqtt_port = int(mqtt_port)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         # no SO_REUSEADDR: a second responder on the port must fail
         # loudly (EADDRINUSE), not silently split datagram delivery

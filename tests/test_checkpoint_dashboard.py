@@ -143,3 +143,76 @@ class TestCli:
         for command in ("registrar", "pipeline", "storage", "recorder",
                         "dashboard", "bench"):
             assert command in result.output
+
+
+class TestCursesUI:
+    def test_curses_loop_renders_selects_and_quits(self, monkeypatch):
+        """Exercise the real curses draw loop (previously '# pragma: no
+        cover') against a fake curses module: renders the service table,
+        selects a row (EC share mirror kicks in), k publishes terminate,
+        q exits."""
+        import sys
+        import types
+        import time as time_module
+        from aiko_services_tpu.dashboard import DashboardModel, _run_curses
+        from aiko_services_tpu.runtime import Process, Registrar
+        from aiko_services_tpu.runtime.actor import Actor
+        from aiko_services_tpu.transport.loopback import get_broker
+
+        process = Process(transport_kind="loopback")
+        Registrar(process, search_timeout=0.05)
+        actor = Actor(process, name="victim")
+        process.run(in_thread=True)
+        model = DashboardModel(process)
+        deadline = time_module.monotonic() + 5
+        while not model.rows and time_module.monotonic() < deadline:
+            get_broker().drain()
+            time_module.sleep(0.01)
+        assert model.rows
+
+        drawn = []
+
+        class FakeScreen:
+            def __init__(self, keys):
+                self.keys = list(keys)
+
+            def erase(self):
+                pass
+
+            def nodelay(self, flag):
+                pass
+
+            def addstr(self, y, x, text, *attrs):
+                drawn.append(text)
+
+            def refresh(self):
+                pass
+
+            def getch(self):
+                return self.keys.pop(0) if self.keys else ord("q")
+
+        fake_curses = types.ModuleType("curses")
+        fake_curses.A_BOLD = 1
+        fake_curses.A_DIM = 2
+        fake_curses.KEY_DOWN = 258
+        fake_curses.KEY_UP = 259
+        fake_curses.curs_set = lambda n: None
+        fake_curses.wrapper = lambda ui: ui(
+            FakeScreen([-1, fake_curses.KEY_DOWN, fake_curses.KEY_UP,
+                        ord("k"), ord("q")]))
+        monkeypatch.setitem(sys.modules, "curses", fake_curses)
+
+        messages = []
+        process.add_message_handler(
+            lambda topic, payload: messages.append((topic, str(payload))),
+            "#")
+        _run_curses(model)
+        joined = " ".join(drawn)
+        assert "dashboard" in joined and "victim" in joined
+        assert model.selected is not None  # selection happened
+        get_broker().drain()
+        # "k" published (terminate) to the selected service's /in
+        assert any(topic == f"{model.selected}/in"
+                   and "terminate" in payload
+                   for topic, payload in messages), messages[-5:]
+        process.terminate()

@@ -160,14 +160,6 @@ class TestDiagnosticLock:
         assert lock.acquire(timeout=0.15) is False
         lock.release()
 
-    def test_resample_preserves_batch_shape(self):
-        from aiko_services_tpu.elements import AudioResample
-        element = TestAudioElements._element(
-            AudioResample, {"rate_in": 16000, "rate_out": 8000})
-        audio = np.random.default_rng(0).standard_normal(
-            (2, 1000)).astype(np.float32)
-        _, outputs = AudioResample.process_frame(element, None, audio)
-        assert np.asarray(outputs["audio"]).shape == (2, 500)
 
     def test_nonblocking_contention(self):
         lock = DiagnosticLock("nb")
@@ -211,6 +203,15 @@ class TestAudioElements:
         peak_hz = np.fft.rfftfreq(len(resampled), 1 / 8000)[
             int(np.argmax(spectrum))]
         assert abs(peak_hz - 440.0) < 8.0
+
+    def test_resample_preserves_batch_shape(self):
+        from aiko_services_tpu.elements import AudioResample
+        element = self._element(
+            AudioResample, {"rate_in": 16000, "rate_out": 8000})
+        audio = np.random.default_rng(0).standard_normal(
+            (2, 1000)).astype(np.float32)
+        _, outputs = AudioResample.process_frame(element, None, audio)
+        assert np.asarray(outputs["audio"]).shape == (2, 500)
 
     def test_resample_identity(self):
         from aiko_services_tpu.elements import AudioResample
@@ -256,7 +257,7 @@ class TestConverterPipelines:
         definition["elements"][0]["parameters"]["data_sources"] = [
             str(frames_dir / "*.png")]
         definition["elements"][1]["parameters"].update(
-            {"data_targets": [str(out_path)], "fps": 5,
+            {"data_targets": [str(out_path)], "frame_rate": 5,
              "fourcc": "MJPG"})
         process = Process(transport_kind="loopback")
         pipeline = create_pipeline(process, definition)
