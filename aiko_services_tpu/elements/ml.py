@@ -190,6 +190,13 @@ class SpeechToText(ComputeElement):
             dtype = self.get_parameter("dtype")
             if dtype:
                 self.config = replace(self.config, dtype=str(dtype))
+            # serving window override: chunked serving (5 s chunks, the
+            # reference cadence) need not pay the full 30 s whisper
+            # window -- encoder cost scales with max_frames
+            max_frames = self.get_parameter("max_frames")
+            if max_frames:
+                self.config = replace(self.config,
+                                      max_frames=int(max_frames))
         else:
             self.config = AsrConfig(
                 d_model=int(self.get_parameter("d_model", 384)),

@@ -108,12 +108,15 @@ class BootstrapResponder:
     def __init__(self, port: int = BOOTSTRAP_PORT,
                  mqtt_host: str | None = None, mqtt_port: int | None = None):
         import threading
-        if mqtt_host is None or mqtt_port is None:
-            # only consult (and possibly TCP-probe) the environment when
-            # the caller didn't pin the endpoint
-            configuration = get_mqtt_configuration()
-            mqtt_host = mqtt_host or configuration["host"]
-            mqtt_port = mqtt_port or configuration["port"]
+        if mqtt_port is None:
+            mqtt_port = int(os.environ.get("AIKO_MQTT_PORT", "1883"))
+        if mqtt_host is None:
+            # probe candidates on the PINNED port, not the env default
+            if os.environ.get("AIKO_MQTT_HOST"):
+                mqtt_host = os.environ["AIKO_MQTT_HOST"]
+            elif os.environ.get("AIKO_MQTT_HOSTS"):
+                mqtt_host = get_mqtt_host(port=int(mqtt_port))
+            mqtt_host = mqtt_host or "localhost"
         self.mqtt_host = mqtt_host
         self.mqtt_port = int(mqtt_port)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)

@@ -174,6 +174,10 @@ def bench_asr(peak):
             {"name": "asr", "input": [{"name": "audio"}],
              "output": [{"name": "tokens"}],
              "parameters": {"preset": preset, "max_tokens": max_tokens,
+                            # 5 s serving chunks need a 512-frame window,
+                            # not whisper's full 30 s (1500): encoder
+                            # cost scales with the window
+                            "max_frames": 192 if SMOKE else 512,
                             "dtype": ("float32" if SMOKE
                                       else "bfloat16")},
              "deploy": _local("SpeechToText")},
@@ -298,7 +302,7 @@ def bench_multimodal(peak):
                enc_layers=4 if not SMOKE else 1,
                dec_layers=4 if not SMOKE else 1,
                n_heads=6 if not SMOKE else 2, vocab_size=1024,
-               max_tokens=16, max_frames=1500,
+               max_tokens=16, max_frames=192 if SMOKE else 512,
                dtype="float32" if SMOKE else "bfloat16")
     det = dict(n_classes=16, base_channels=8 if SMOKE else 32,
                image_size=image_size,
