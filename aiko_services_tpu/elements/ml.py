@@ -22,7 +22,8 @@ from ..models import (
 from ..models import configs as model_configs
 from ..ops import log_mel_spectrogram
 from ..ops.device import as_device_array as _as_device_array
-from ..pipeline import ComputeElement, PipelineElement, StreamEvent
+from ..pipeline import (
+    AsyncHostElement, ComputeElement, PipelineElement, StreamEvent)
 from ..utils import get_logger
 
 __all__ = ["LMForward", "LMGenerate", "SpeechToText", "TextToSpeech",
@@ -282,13 +283,17 @@ class TextToSpeech(ComputeElement):
             "audio": waveform, "sample_rate": self.config.sample_rate}
 
 
-class TokensToText(PipelineElement):
+class TokensToText(AsyncHostElement):
     """tokens (B, T) -> text list[str] (explicit host boundary: this is
     where token ids leave the device).  With a "tokenizer" parameter
     ("default" or a path) decoding uses the real BPE vocabulary; without
-    one, the byte-level toy vocabulary."""
+    one, the byte-level toy vocabulary.
 
-    def process_frame(self, stream, tokens):
+    Runs as an ASYNC host element: the device->host readback (a fixed
+    ~100 ms round-trip on tunneled TPUs) happens on a worker thread with
+    the frame parked, so it never serializes the pipeline."""
+
+    def process_async(self, stream, tokens):
         token_array = np.asarray(tokens)
         tokenizer = _tokenizer_for(self)
         texts = []
@@ -299,7 +304,7 @@ class TokensToText(PipelineElement):
                 data = bytes(int(t) - _BYTE_OFFSET for t in row
                              if _BYTE_OFFSET <= t < _BYTE_OFFSET + 256)
                 texts.append(data.decode("utf-8", errors="replace"))
-        return StreamEvent.OKAY, {"text": texts}
+        return {"text": texts}
 
 
 class TextToTokens(PipelineElement):

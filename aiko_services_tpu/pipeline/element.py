@@ -20,7 +20,7 @@ from ..runtime import Actor
 from ..utils import get_logger
 from .stream import Stream, StreamEvent, StreamState
 
-__all__ = ["PipelineElement", "FrameGeneratorHandle"]
+__all__ = ["PipelineElement", "AsyncHostElement", "FrameGeneratorHandle"]
 
 _LOGGER = get_logger("element")
 
@@ -163,3 +163,63 @@ class PipelineElement(Actor):
             handle.terminate()
         self._generators.clear()
         super().stop()
+
+
+class AsyncHostElement(PipelineElement):
+    """PipelineElement whose work runs on a WORKER THREAD while the frame
+    parks (StreamEvent.PENDING) -- the host-boundary counterpart of a
+    remote hop.
+
+    Device->host readbacks (token decode, image sinks) carry a fixed
+    device-link round-trip (~100 ms on tunneled TPUs); run inline on the
+    event loop they serialize the whole pipeline.  Subclasses implement
+    process_async(stream, **inputs) -> dict (worker thread, blocking I/O
+    welcome); the frame resumes through the pipeline mailbox when it
+    returns, so other frames flow through the graph meanwhile.  An
+    exception in process_async releases the frame as an error (no leak).
+    Worker concurrency: the "workers" parameter (default 2) bounds
+    simultaneous readbacks per element.
+    """
+
+    _executor = None
+
+    def process_async(self, stream: Stream, **inputs) -> dict:
+        raise NotImplementedError
+
+    def _get_executor(self):
+        if self._executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._executor = ThreadPoolExecutor(
+                max_workers=int(self.get_parameter("workers", 2)),
+                thread_name_prefix=f"async-{self.definition.name}")
+        return self._executor
+
+    def process_frame(self, stream: Stream, **inputs) -> tuple:
+        frame_id = stream.current_frame_id
+        stream_id = stream.stream_id
+        pipeline = self.pipeline
+
+        def work():
+            start = time.perf_counter()
+            try:
+                outputs = self.process_async(stream, **inputs)
+                pipeline.post_message("process_frame_response", [
+                    {"stream_id": stream_id, "frame_id": frame_id,
+                     "time": time.perf_counter() - start},
+                    outputs or {}])
+            except Exception as error:
+                _LOGGER.error("%s: async work failed: %s",
+                              self.definition.name, error)
+                pipeline.post_message("process_frame_response", [
+                    {"stream_id": stream_id, "frame_id": frame_id,
+                     "event": "error"}, {}])
+
+        self._get_executor().submit(work)
+        return StreamEvent.PENDING, None
+
+    def stop(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+        super().stop()
+

@@ -341,8 +341,19 @@ class Pipeline(Actor):
             self._finish_frame(stream, frame, dropped=True,
                                error=(remote_event == "error"))
             return
-        frame.swag.update(frame_data or {})
         resumed_node = frame.paused_pe_name
+        outputs = frame_data or {}
+        element = self.elements.get(resumed_node)
+        if element is not None and not isinstance(element, RemoteElement):
+            # async LOCAL element: its map_out has not been applied yet
+            # (remote hops apply map_out on the serving side)
+            outputs = self._map_out(outputs, element.definition)
+        elapsed = stream_dict.get("time")
+        if elapsed is not None:
+            frame.metrics[f"time_{resumed_node}"] = (
+                frame.metrics.get(f"time_{resumed_node}", 0.0)
+                + float(elapsed))
+        frame.swag.update(outputs)
         frame.paused_pe_name = None
         self._run_frame(stream, frame, resume_after=resumed_node)
 
@@ -354,6 +365,7 @@ class Pipeline(Actor):
         for node_name in nodes:
             if stream.state != StreamState.RUN:
                 break
+            stream.current_frame_id = frame.frame_id
             element = self.elements[node_name]
             definition = element.definition
             try:
@@ -380,6 +392,13 @@ class Pipeline(Actor):
                 + time.perf_counter() - element_start)
             if stream_event == StreamEvent.OKAY:
                 frame.swag.update(self._map_out(outputs or {}, definition))
+            elif stream_event == StreamEvent.PENDING:
+                # element continues off the event loop (AsyncHostElement
+                # worker thread); frame parks exactly like a remote hop
+                # and resumes through process_frame_response -- the event
+                # loop is free for other frames meanwhile
+                frame.paused_pe_name = node_name
+                return
             elif stream_event == StreamEvent.DROP_FRAME:
                 self._finish_frame(stream, frame, dropped=True)
                 return

@@ -30,6 +30,10 @@ class StreamEvent(Enum):
     STOP = "stop"
     ERROR = "error"
     DROP_FRAME = "drop_frame"
+    # frame parks at this element (work continues off the event loop --
+    # AsyncHostElement worker or remote hop); a process_frame_response
+    # resumes it.  Other frames keep flowing meanwhile.
+    PENDING = "pending"
     USER = "user"
 
 
@@ -62,6 +66,10 @@ class Stream:
     pending: int = 0    # frames posted but not yet finished (backpressure)
     stop_requested: bool = False   # graceful stop: destroy when pending==0
     destroying: bool = False       # destroy_stream in progress (reentrancy)
+    # the frame_id the engine is currently executing an element for --
+    # explicit context (the reference used thread-locals, pipeline.py:
+    # 584-610); AsyncHostElement uses it to address its resume message
+    current_frame_id: int | None = None
 
     def to_dict(self) -> dict:
         return {"stream_id": self.stream_id, "frame_id": self.frame_id}

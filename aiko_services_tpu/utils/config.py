@@ -44,20 +44,23 @@ def get_bool_env(name: str, default: bool = False) -> bool:
     return value.strip().lower() in ("1", "true", "yes", "on", "all")
 
 
-def get_mqtt_configuration() -> dict:
+def get_mqtt_configuration(port: int | None = None) -> dict:
     """MQTT endpoint settings (reference configuration.py:101-114).
 
     AIKO_MQTT_HOST names a broker directly (no probe -- tests and fixed
     deployments).  Otherwise, when AIKO_MQTT_HOSTS lists candidates, the
     first one answering a TCP connect probe wins (reference
     configuration.py:121-139); nothing reachable falls back to
-    localhost."""
+    localhost.  `port` pins the probe/endpoint port (default
+    AIKO_MQTT_PORT)."""
+    if port is None:
+        port = int(os.environ.get("AIKO_MQTT_PORT", "1883"))
     host = os.environ.get("AIKO_MQTT_HOST")
     if not host and os.environ.get("AIKO_MQTT_HOSTS"):
-        host = get_mqtt_host()
+        host = get_mqtt_host(port=int(port))
     return {
         "host": host or "localhost",
-        "port": int(os.environ.get("AIKO_MQTT_PORT", "1883")),
+        "port": int(port),
         "transport": os.environ.get("AIKO_MQTT_TRANSPORT", "tcp"),
         "username": os.environ.get("AIKO_USERNAME"),
         "password": os.environ.get("AIKO_PASSWORD"),
@@ -111,12 +114,8 @@ class BootstrapResponder:
         if mqtt_port is None:
             mqtt_port = int(os.environ.get("AIKO_MQTT_PORT", "1883"))
         if mqtt_host is None:
-            # probe candidates on the PINNED port, not the env default
-            if os.environ.get("AIKO_MQTT_HOST"):
-                mqtt_host = os.environ["AIKO_MQTT_HOST"]
-            elif os.environ.get("AIKO_MQTT_HOSTS"):
-                mqtt_host = get_mqtt_host(port=int(mqtt_port))
-            mqtt_host = mqtt_host or "localhost"
+            # shared resolution ladder, probing on the PINNED port
+            mqtt_host = get_mqtt_configuration(port=int(mqtt_port))["host"]
         self.mqtt_host = mqtt_host
         self.mqtt_port = int(mqtt_port)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)

@@ -192,7 +192,7 @@ def test_tokens_to_text_out_of_range_ids():
     element = TokensToText.__new__(TokensToText)
     element.get_parameter = lambda name, default=None, stream=None: default
     tokens = np.array([[0, 1, 2, 3 + ord("h"), 3 + ord("i"), 300, 1023]])
-    _, outputs = element.process_frame(None, tokens)
+    outputs = element.process_async(None, tokens=tokens)
     assert outputs["text"] == ["hi"]
 
 

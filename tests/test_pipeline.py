@@ -1,9 +1,11 @@
 import queue
+import time
 
 import pytest
 
 from aiko_services_tpu.pipeline import (
-    DefinitionError, StreamState, create_pipeline, parse_pipeline_definition)
+    AsyncHostElement, DefinitionError, StreamState, create_pipeline,
+    parse_pipeline_definition)
 from aiko_services_tpu.runtime import Process, Registrar
 from aiko_services_tpu.transport import reset_brokers
 from helpers import wait_for
@@ -317,4 +319,102 @@ def test_stream_lease_expires_without_frames():
                            queue_response=responses)
     responses.get(timeout=5)  # single frame flows, then stream idles
     wait_for(lambda: "short" not in pipeline.streams, timeout=5)
+    process.terminate()
+
+
+class SlowHostSink(AsyncHostElement):
+    """Test double: a host-boundary element that blocks 0.2 s off-loop."""
+
+    def process_async(self, stream, number):
+        import time as time_module
+        time_module.sleep(0.2)
+        return {"number": int(number) * 10}
+
+
+class ExplodingHostSink(AsyncHostElement):
+    def process_async(self, stream, number):
+        raise RuntimeError("host boundary failed")
+
+
+def test_async_host_element_parks_and_resumes_with_map_out():
+    definition = {
+        "name": "async_pipe",
+        "graph": ["(source (sink))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [7]},
+             "deploy": local("PE_Number")},
+            {"name": "sink", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "map_out": {"number": "scaled"},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SlowHostSink"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    _, frame, outputs = responses.get(timeout=10)
+    assert outputs["scaled"] == 70
+    assert frame.metrics["time_sink"] >= 0.2  # worker time recorded
+    assert frame.paused_pe_name is None
+    process.terminate()
+
+
+def test_async_host_element_overlaps_frames():
+    """Five frames through a 0.2 s host boundary must overlap (parked
+    frames free the event loop), not serialize to >= 1 s."""
+    definition = {
+        "name": "overlap_pipe",
+        "graph": ["(source (sink))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [1, 2, 3, 4, 5]},
+             "deploy": local("PE_Number")},
+            {"name": "sink", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "parameters": {"workers": 5},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SlowHostSink"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    start = time.monotonic()
+    pipeline.create_stream("s1", queue_response=responses)
+    results = sorted(outputs["number"]
+                     for _, _, outputs in drain(responses, 5))
+    elapsed = time.monotonic() - start
+    assert results == [10, 20, 30, 40, 50]
+    assert elapsed < 0.8, f"frames serialized: {elapsed:.2f}s"
+    process.terminate()
+
+
+def test_async_host_element_error_releases_frame():
+    definition = {
+        "name": "boom_pipe",
+        "graph": ["(source (sink))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [1]},
+             "deploy": local("PE_Number")},
+            {"name": "sink", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "ExplodingHostSink"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    wait_for(lambda: ("s1" not in pipeline.streams
+                      or not pipeline.streams["s1"].frames), timeout=10)
+    stream = pipeline.streams.get("s1")
+    assert stream is None or not stream.frames  # no parked-frame leak
     process.terminate()
