@@ -18,7 +18,7 @@ from .image_io import (                                       # noqa: F401
     ImageOutput)
 from .audio_io import (                                       # noqa: F401
     AudioReadFile, AudioWriteFile, ToneSource, AudioFraming, AudioSample,
-    AudioFFT, AudioResample)
+    AudioFFT, AudioResample, MicrophoneSource, SpeakerSink)
 from .video_io import (                                       # noqa: F401
     VideoReadFile, VideoSample, VideoWriteFile, VideoOutput)
 from .webcam_io import VideoReadWebcam                        # noqa: F401

@@ -17,8 +17,8 @@ from ..utils import get_logger
 from .common_io import DataSource, DataTarget, Sample
 
 __all__ = ["AudioReadFile", "AudioWriteFile", "ToneSource", "AudioFraming",
-           "AudioSample", "AudioFFT", "AudioResample", "synthesize_tone",
-           "SAMPLE_RATE"]
+           "AudioSample", "AudioFFT", "AudioResample", "MicrophoneSource",
+           "SpeakerSink", "synthesize_tone", "SAMPLE_RATE"]
 
 _LOGGER = get_logger("audio_io")
 SAMPLE_RATE = 16000  # reference audio_io.py:455-460: 16 kHz
@@ -175,3 +175,116 @@ class AudioResample(PipelineElement):
         resampled = resampled.reshape(*lead_shape, out_samples)
         return StreamEvent.OKAY, {"audio": resampled,
                                   "sample_rate": rate_out}
+
+
+def _truthy(value) -> bool:
+    """Share/EC values arrive over the wire as strings ("true"/"false");
+    normalize exactly like the engine does elsewhere."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    return bool(value)
+
+
+class MicrophoneSource(DataSource):
+    """Live microphone chunks (the reference's PE_MicrophoneSD seat,
+    audio_io.py:440-520: sounddevice, 16 kHz, 5 s chunks, with a mute
+    protocol so a speaker can silence it during playback).
+
+    Hardware-gated exactly like webcam/gstreamer: sounddevice missing or
+    no capture device -> a clear start_stream error, not an import
+    crash.  The "mute" share flag is live-updatable over EC (the
+    reference's speaker publishes (update mute true) to the microphone
+    service); muted chunks emit zeros so downstream framing stays
+    continuous.
+    """
+
+    def start_stream(self, stream, stream_id):
+        try:
+            import sounddevice  # noqa: F401
+        except ImportError:
+            return StreamEvent.ERROR, {
+                "diagnostic": "sounddevice is not installed "
+                              "(pip install sounddevice)"}
+        self.share.setdefault("mute", False)
+        chunk_seconds = float(
+            self.get_parameter("chunk_seconds", 5.0, stream))
+        sample_rate = int(
+            self.get_parameter("sample_rate", SAMPLE_RATE, stream))
+
+        def frames(stream, frame_id):
+            import sounddevice
+            recording = sounddevice.rec(
+                int(chunk_seconds * sample_rate), samplerate=sample_rate,
+                channels=1, dtype="float32")
+            sounddevice.wait()
+            audio = recording.reshape(-1)
+            if _truthy(self.get_parameter("mute", False, stream)):
+                audio = np.zeros_like(audio)
+            return StreamEvent.OKAY, {"audio": audio}
+
+        self.create_frames(stream, frames)
+        return StreamEvent.OKAY, None
+
+
+class SpeakerSink(DataTarget):
+    """Audio playback (the reference's PE_Speaker seat, audio_io.py:
+    560-640): plays {"audio"} frames and, while playing, MUTES a
+    discovered microphone service so the pipeline does not hear itself
+    (the reference's mute protocol -- (update mute true/false) on the
+    microphone's /control topic via its EC share)."""
+
+    _microphone_topic = None
+
+    def start_stream(self, stream, stream_id):
+        # no file targets (DataTarget's data_targets contract does not
+        # apply to playback); begin microphone discovery now so the
+        # cache is synced before the first frame plays
+        if self.get_parameter("microphone_service", None, stream):
+            self._resolve_microphone(stream)
+        return StreamEvent.OKAY, None
+
+    def _resolve_microphone(self, stream):
+        if self._microphone_topic is not None:
+            return self._microphone_topic
+        name = self.get_parameter("microphone_service", None, stream)
+        if not name:
+            return None
+        from ..runtime import ServiceFilter
+        from ..runtime.share import services_cache_create_singleton
+        cache = services_cache_create_singleton(self.process)
+        matches = list(cache.services.filter_services(
+            ServiceFilter(name=str(name))))
+        if matches:
+            self._microphone_topic = matches[0].topic_path
+        else:
+            _LOGGER.warning(
+                "%s: microphone service '%s' not discovered yet; "
+                "playing unmuted", self.definition.name, name)
+        return self._microphone_topic
+
+    def _set_mute(self, topic_path, muted: bool):
+        from ..utils import generate
+        self.process.publish(
+            f"{topic_path}/control",
+            generate("update", ["mute", "true" if muted else "false"]))
+
+    def process_frame(self, stream, audio):
+        try:
+            import sounddevice
+        except ImportError:
+            return StreamEvent.ERROR, {
+                "diagnostic": "sounddevice is not installed "
+                              "(pip install sounddevice)"}
+        sample_rate = int(self.get_parameter(
+            "sample_rate", SAMPLE_RATE, stream))
+        microphone = self._resolve_microphone(stream)
+        if microphone:
+            self._set_mute(microphone, True)
+        try:
+            array = np.asarray(audio, np.float32).reshape(-1)
+            sounddevice.play(array, samplerate=sample_rate)
+            sounddevice.wait()
+        finally:
+            if microphone:
+                self._set_mute(microphone, False)
+        return StreamEvent.OKAY, {"audio": audio}

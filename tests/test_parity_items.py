@@ -276,3 +276,142 @@ class TestConverterPipelines:
             count += 1
         capture.release()
         assert count == 3
+
+
+class TestMicrophoneSpeaker:
+    """The reference's mic/speaker seats (audio_io.py:440-640) with the
+    mute protocol, exercised over a fake sounddevice module."""
+
+    @staticmethod
+    def _fake_sounddevice(recorded, played):
+        import types
+        fake = types.ModuleType("sounddevice")
+
+        def rec(samples, samplerate, channels, dtype):
+            recorded.append(samples)
+            return np.full((samples, 1), 0.25, np.float32)
+
+        fake.rec = rec
+        fake.play = lambda array, samplerate: played.append(
+            (np.asarray(array), samplerate))
+        fake.wait = lambda: None
+        return fake
+
+    def test_gated_without_sounddevice(self, monkeypatch):
+        import sys
+        from aiko_services_tpu.elements import MicrophoneSource
+        # force ImportError even on hosts that have sounddevice
+        monkeypatch.setitem(sys.modules, "sounddevice", None)
+        element = MicrophoneSource.__new__(MicrophoneSource)
+        element.share = {}
+        element.get_parameter = (
+            lambda name, default=None, stream=None: default)
+        event, outputs = MicrophoneSource.start_stream(element, None, "s")
+        from aiko_services_tpu.pipeline import StreamEvent
+        assert event == StreamEvent.ERROR
+        assert "sounddevice" in outputs["diagnostic"]
+
+    def test_speaker_mutes_discovered_microphone(self, monkeypatch):
+        import sys
+        import queue as queue_module
+        from aiko_services_tpu.runtime import Process, Registrar
+        from aiko_services_tpu.pipeline import create_pipeline
+        from aiko_services_tpu.transport.loopback import get_broker
+        from aiko_services_tpu.elements.robot import RobotActor  # any svc
+
+        recorded, played = [], []
+        monkeypatch.setitem(
+            sys.modules, "sounddevice",
+            self._fake_sounddevice(recorded, played))
+
+        process = Process(transport_kind="loopback")
+        Registrar(process, search_timeout=0.05)
+        # stand-in microphone service: capture (update mute ...) on its
+        # control topic (the ECProducer normally consumes these)
+        mic = RobotActor(process, name="mic_service")
+        mutes = []
+        process.add_message_handler(
+            lambda topic, payload: mutes.append(str(payload)),
+            f"{mic.topic_path}/control")
+        definition = {
+            "name": "playback",
+            "graph": ["(tone (speaker))"],
+            "elements": [
+                {"name": "tone", "output": [{"name": "audio"}],
+                 "parameters": {"data_sources": [[440, 0.01]]},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "ToneSource"}}},
+                {"name": "speaker", "input": [{"name": "audio"}],
+                 "output": [{"name": "audio"}],
+                 "parameters": {"microphone_service": "mic_service"},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "SpeakerSink"}}},
+            ],
+        }
+        pipeline = create_pipeline(process, definition)
+        process.run(in_thread=True)
+        # warm registrar discovery so the speaker finds the microphone
+        from aiko_services_tpu.runtime import ServiceFilter
+        from aiko_services_tpu.runtime.share import (
+            services_cache_create_singleton)
+        cache = services_cache_create_singleton(process)
+        deadline = time.monotonic() + 5
+        while (not list(cache.services.filter_services(
+                ServiceFilter(name="mic_service")))
+               and time.monotonic() < deadline):
+            get_broker().drain()
+            time.sleep(0.01)
+        responses = queue_module.Queue()
+        pipeline.create_stream("s1", queue_response=responses)
+        responses.get(timeout=10)
+        assert played and played[0][1] == 16000
+        deadline = time.monotonic() + 5
+        while len(mutes) < 2 and time.monotonic() < deadline:
+            get_broker().drain()
+            time.sleep(0.01)
+        assert any("mute" in m and "true" in m for m in mutes), mutes
+        assert any("mute" in m and "false" in m for m in mutes), mutes
+        process.terminate()
+
+    def test_microphone_chunks_and_mute_zeroing(self, monkeypatch):
+        import sys
+        recorded, played = [], []
+        monkeypatch.setitem(
+            sys.modules, "sounddevice",
+            self._fake_sounddevice(recorded, played))
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.pipeline import create_pipeline
+        import queue as queue_module
+
+        definition = {
+            "name": "mic_pipe",
+            "graph": ["(mic)"],
+            "elements": [
+                {"name": "mic", "output": [{"name": "audio"}],
+                 "parameters": {"chunk_seconds": 0.01, "frame_window": 1},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "MicrophoneSource"}}},
+            ],
+        }
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, definition)
+        process.run(in_thread=True)
+        responses = queue_module.Queue()
+        pipeline.create_stream("s1", queue_response=responses)
+        _, _, outputs = responses.get(timeout=10)
+        audio = np.asarray(outputs["audio"])
+        assert audio.shape == (160,)           # 0.01 s at 16 kHz
+        assert np.allclose(audio, 0.25)        # live chunk
+        # live mute: flip the share flag, next chunks are zeroed
+        element = pipeline.elements["mic"]
+        element.share["mute"] = "true"  # wire form: EC stores strings
+        for _ in range(3):
+            _, _, outputs = responses.get(timeout=10)
+            if np.allclose(np.asarray(outputs["audio"]), 0.0):
+                break
+        assert np.allclose(np.asarray(outputs["audio"]), 0.0)
+        pipeline.destroy_stream("s1")
+        process.terminate()
