@@ -13,7 +13,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..pipeline import PipelineElement, StreamEvent
-from ..utils import get_logger
+from ..utils import get_logger, truthy as _truthy
+from ..pipeline import AsyncHostElement
 from .common_io import DataSource, DataTarget, Sample
 
 __all__ = ["AudioReadFile", "AudioWriteFile", "ToneSource", "AudioFraming",
@@ -177,14 +178,6 @@ class AudioResample(PipelineElement):
                                   "sample_rate": rate_out}
 
 
-def _truthy(value) -> bool:
-    """Share/EC values arrive over the wire as strings ("true"/"false");
-    normalize exactly like the engine does elsewhere."""
-    if isinstance(value, str):
-        return value.strip().lower() in ("1", "true", "yes", "on")
-    return bool(value)
-
-
 class MicrophoneSource(DataSource):
     """Live microphone chunks (the reference's PE_MicrophoneSD seat,
     audio_io.py:440-520: sounddevice, 16 kHz, 5 s chunks, with a mute
@@ -200,11 +193,21 @@ class MicrophoneSource(DataSource):
 
     def start_stream(self, stream, stream_id):
         try:
-            import sounddevice  # noqa: F401
+            import sounddevice
         except ImportError:
             return StreamEvent.ERROR, {
                 "diagnostic": "sounddevice is not installed "
                               "(pip install sounddevice)"}
+        try:  # promised diagnostic: a clear error when no capture device
+            if hasattr(sounddevice, "query_devices"):
+                devices = sounddevice.query_devices()
+                if not any(d.get("max_input_channels", 0) > 0
+                           for d in devices):
+                    return StreamEvent.ERROR, {
+                        "diagnostic": "no audio capture device available"}
+        except Exception as error:
+            return StreamEvent.ERROR, {
+                "diagnostic": f"audio device probe failed: {error}"}
         self.share.setdefault("mute", False)
         chunk_seconds = float(
             self.get_parameter("chunk_seconds", 5.0, stream))
@@ -226,19 +229,23 @@ class MicrophoneSource(DataSource):
         return StreamEvent.OKAY, None
 
 
-class SpeakerSink(DataTarget):
+class SpeakerSink(AsyncHostElement):
     """Audio playback (the reference's PE_Speaker seat, audio_io.py:
     560-640): plays {"audio"} frames and, while playing, MUTES a
     discovered microphone service so the pipeline does not hear itself
     (the reference's mute protocol -- (update mute true/false) on the
-    microphone's /control topic via its EC share)."""
+    microphone's /control topic via its EC share).
+
+    Playback blocks for the clip's duration, so it runs as an ASYNC
+    host element: the frame parks during play and the pipeline keeps
+    flowing other frames."""
 
     _microphone_topic = None
+    _discovery_warned = False
 
     def start_stream(self, stream, stream_id):
-        # no file targets (DataTarget's data_targets contract does not
-        # apply to playback); begin microphone discovery now so the
-        # cache is synced before the first frame plays
+        # begin microphone discovery now so the cache is synced before
+        # the first frame plays
         if self.get_parameter("microphone_service", None, stream):
             self._resolve_microphone(stream)
         return StreamEvent.OKAY, None
@@ -256,10 +263,12 @@ class SpeakerSink(DataTarget):
             ServiceFilter(name=str(name))))
         if matches:
             self._microphone_topic = matches[0].topic_path
-        else:
+        elif not self._discovery_warned:  # once, not per chunk
+            self._discovery_warned = True
             _LOGGER.warning(
                 "%s: microphone service '%s' not discovered yet; "
-                "playing unmuted", self.definition.name, name)
+                "playing unmuted until it registers",
+                self.definition.name, name)
         return self._microphone_topic
 
     def _set_mute(self, topic_path, muted: bool):
@@ -268,13 +277,13 @@ class SpeakerSink(DataTarget):
             f"{topic_path}/control",
             generate("update", ["mute", "true" if muted else "false"]))
 
-    def process_frame(self, stream, audio):
+    def process_async(self, stream, audio):
         try:
             import sounddevice
-        except ImportError:
-            return StreamEvent.ERROR, {
-                "diagnostic": "sounddevice is not installed "
-                              "(pip install sounddevice)"}
+        except ImportError as error:
+            raise RuntimeError(
+                "sounddevice is not installed "
+                "(pip install sounddevice)") from error
         sample_rate = int(self.get_parameter(
             "sample_rate", SAMPLE_RATE, stream))
         microphone = self._resolve_microphone(stream)
@@ -287,4 +296,4 @@ class SpeakerSink(DataTarget):
         finally:
             if microphone:
                 self._set_mute(microphone, False)
-        return StreamEvent.OKAY, {"audio": audio}
+        return {"audio": audio}

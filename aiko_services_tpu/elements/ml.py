@@ -48,11 +48,21 @@ _DETECTOR_PRESETS = {
 
 
 def _transformer_config(element) -> TransformerConfig:
+    # sequence_parallel: long-context attention over the element mesh's
+    # "seq" axis (ring prefill + sp decode); requires the element's
+    # sharding block to name a seq axis
+    from ..utils import truthy
+    sequence_parallel = truthy(
+        element.get_parameter("sequence_parallel", False))
     preset = element.get_parameter("preset")
     if preset:
         config = _LM_PRESETS[str(preset)]
         dtype = element.get_parameter("dtype")
-        return replace(config, dtype=str(dtype)) if dtype else config
+        if dtype:
+            config = replace(config, dtype=str(dtype))
+        if sequence_parallel:
+            config = replace(config, sequence_parallel=True)
+        return config
     return TransformerConfig(
         vocab_size=int(element.get_parameter("vocab_size", 8192)),
         d_model=int(element.get_parameter("d_model", 512)),
@@ -62,6 +72,7 @@ def _transformer_config(element) -> TransformerConfig:
         d_ff=int(element.get_parameter("d_ff", 1536)),
         max_seq_len=int(element.get_parameter("max_seq_len", 2048)),
         dtype=str(element.get_parameter("dtype", "bfloat16")),
+        sequence_parallel=sequence_parallel,
     )
 
 
@@ -129,7 +140,23 @@ class LMGenerate(ComputeElement):
         self.tokenizer = _tokenizer_for(self)
         return _load_transformer_params(self, self.config)
 
+    def _sp_cache(self, batch: int, max_len: int):
+        """KV cache laid out for sequence-parallel decode: length sharded
+        over the element mesh's seq axis (padded to divide it)."""
+        from ..models import cache_specs, init_cache
+        from ..parallel import filter_specs, shard_pytree
+        if self.mesh is None or "seq" not in self.mesh.axis_names:
+            raise ValueError(
+                f"{self.definition.name}: sequence_parallel needs a "
+                "sharding block whose axes include 'seq'")
+        seq_size = self.mesh.shape["seq"]
+        max_len = ((max_len + seq_size - 1) // seq_size) * seq_size
+        return shard_pytree(
+            init_cache(self.config, batch, max_len=max_len), self.mesh,
+            filter_specs(cache_specs(sequence_parallel=True), self.mesh))
+
     def process_frame(self, stream, tokens=None, text=None):
+        import contextlib
         self._ensure_ready()
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
         if tokens is None:
@@ -145,22 +172,45 @@ class LMGenerate(ComputeElement):
             for row, ids in enumerate(encoded):
                 tokens[row, width - len(ids):] = ids  # left-pad
         tokens = _as_device_array(tokens, jnp.int32)
-        if bool(self.get_parameter("stream_tokens", False, stream)):
-            # streamed serving path: publish token chunks to /out as they
-            # decode (reference capability: Ollama token streaming)
-            chunk = int(self.get_parameter("stream_chunk", 8, stream))
-            blocks = []
-            for offset, block in generate_stream(
-                    self.state, self.config, tokens, max_new, chunk=chunk):
-                blocks.append(block)
-                payload = block.tolist()
-                if self.tokenizer is not None:
-                    payload = [self.tokenizer.decode(row) for row in block]
-                self.publish_out("tokens",
-                                 [stream.stream_id, offset, payload])
-            out = np.concatenate(blocks, axis=1)
-        else:
-            out, _ = generate(self.state, self.config, tokens, max_new)
+        if self.config.sequence_parallel:
+            # ring prefill shards the prompt over the seq axis: LEFT-pad
+            # the prompt up to a seq-multiple (same semantics as the
+            # batch left-padding above)
+            seq_size = (self.mesh.shape.get("seq", 1)
+                        if self.mesh is not None else 1)
+            width = tokens.shape[1]
+            target = ((width + seq_size - 1) // seq_size) * seq_size
+            if target != width:
+                pad_block = jnp.zeros(
+                    (tokens.shape[0], target - width), jnp.int32)
+                tokens = jnp.concatenate([pad_block, tokens], axis=1)
+        # sequence_parallel: ring prefill + sp decode run shard_map over
+        # the AMBIENT mesh, and the cache must be seq-sharded
+        mesh_scope = (jax.set_mesh(self.mesh) if self.mesh is not None
+                      else contextlib.nullcontext())
+        with mesh_scope:
+            cache = (self._sp_cache(tokens.shape[0],
+                                    tokens.shape[1] + max_new)
+                     if self.config.sequence_parallel else None)
+            if bool(self.get_parameter("stream_tokens", False, stream)):
+                # streamed serving path: publish token chunks to /out as
+                # they decode (reference capability: Ollama streaming)
+                chunk = int(self.get_parameter("stream_chunk", 8, stream))
+                blocks = []
+                for offset, block in generate_stream(
+                        self.state, self.config, tokens, max_new,
+                        cache=cache, chunk=chunk):
+                    blocks.append(block)
+                    payload = block.tolist()
+                    if self.tokenizer is not None:
+                        payload = [self.tokenizer.decode(row)
+                                   for row in block]
+                    self.publish_out("tokens",
+                                     [stream.stream_id, offset, payload])
+                out = np.concatenate(blocks, axis=1)
+            else:
+                out, _ = generate(self.state, self.config, tokens,
+                                  max_new, cache=cache)
         result = {"generated": out}
         if self.tokenizer is not None:
             result["text"] = [self.tokenizer.decode(np.asarray(row))

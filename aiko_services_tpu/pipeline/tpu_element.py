@@ -25,6 +25,7 @@
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import time
 
@@ -221,8 +222,14 @@ class ComputeElement(PipelineElement):
                 if name in inputs}
         try:
             # TraceAnnotation: per-element spans in jax.profiler traces
-            # (SURVEY.md section 5 tracing parity)
-            with jax.profiler.TraceAnnotation(
+            # (SURVEY.md section 5 tracing parity).  The element's mesh
+            # becomes the AMBIENT mesh for the compiled call, so compute
+            # bodies may use shard_map collectives with mesh=None (ring
+            # attention, sp decode -- the long-context path).
+            mesh_scope = (jax.set_mesh(self.mesh)
+                          if self.mesh is not None
+                          else contextlib.nullcontext())
+            with mesh_scope, jax.profiler.TraceAnnotation(
                     f"element:{self.definition.name}"):
                 outputs = self._compiled(self.state, dynamic, placed)
         except TypeError as error:

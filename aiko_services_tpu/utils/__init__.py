@@ -4,7 +4,7 @@ from .sexpr import (                                        # noqa: F401
 from .graph import Graph, Node, GraphError                  # noqa: F401
 from .config import (                                       # noqa: F401
     get_namespace, get_hostname, get_pid, get_transport_configuration,
-    get_mqtt_configuration, get_bool_env, probe_tcp, get_mqtt_host,
+    get_mqtt_configuration, get_bool_env, truthy, probe_tcp, get_mqtt_host,
     BootstrapResponder)
 from .lock import DiagnosticLock                            # noqa: F401
 from .lru_cache import LRUCache                             # noqa: F401

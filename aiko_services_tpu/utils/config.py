@@ -14,7 +14,8 @@ import socket
 
 __all__ = [
     "get_namespace", "get_hostname", "get_pid", "get_transport_configuration",
-    "get_mqtt_configuration", "get_bool_env", "probe_tcp", "get_mqtt_host",
+    "get_mqtt_configuration", "get_bool_env", "truthy", "probe_tcp",
+    "get_mqtt_host",
     "BootstrapResponder",
 ]
 
@@ -37,11 +38,19 @@ def get_pid() -> str:
     return str(os.getpid())
 
 
+def truthy(value) -> bool:
+    """Normalize wire/share/env boolean forms: EC updates and S-expr
+    payloads deliver strings ("true"/"false"), Python code passes bools."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes", "on", "all")
+    return bool(value)
+
+
 def get_bool_env(name: str, default: bool = False) -> bool:
     value = os.environ.get(name)
     if value is None:
         return default
-    return value.strip().lower() in ("1", "true", "yes", "on", "all")
+    return truthy(value)
 
 
 def get_mqtt_configuration(port: int | None = None) -> dict:
