@@ -104,5 +104,34 @@ def bench() -> None:
     runpy.run_path(str(bench_path), run_name="__main__")
 
 
+@main.command()
+@click.option("--port", default=None, type=int,
+              help="UDP port to answer on (default 4149)")
+@click.option("--mqtt-host", default=None,
+              help="Broker host to advertise (default: resolved from "
+                   "AIKO_MQTT_HOST/AIKO_MQTT_HOSTS with a TCP probe)")
+@click.option("--mqtt-port", default=None, type=int)
+def bootstrap(port: int | None, mqtt_host: str | None,
+              mqtt_port: int | None) -> None:
+    """MCU bootstrap responder: answers UDP boot datagrams with the
+    namespace + broker endpoint (reference configuration.py:168-186)."""
+    import signal
+    import time
+
+    from .utils import BootstrapResponder
+    kwargs = {"mqtt_host": mqtt_host, "mqtt_port": mqtt_port}
+    if port is not None:
+        kwargs["port"] = port
+    responder = BootstrapResponder(**kwargs)
+    click.echo(f"bootstrap responder on udp/{responder.port} advertising "
+               f"{responder.mqtt_host}:{responder.mqtt_port}")
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(True))
+    signal.signal(signal.SIGINT, lambda *_: stop.append(True))
+    while not stop:
+        time.sleep(0.2)
+    responder.close()
+
+
 if __name__ == "__main__":
     main()

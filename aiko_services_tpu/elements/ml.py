@@ -124,7 +124,7 @@ class LMForward(ComputeElement):
         logits = forward(state, self.config, tokens)
         log_probs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
         taken = jnp.take_along_axis(
-            log_probs, tokens[:, 1:, None], axis=-1)[..., 0]
+            log_probs, tokens[:, 1:, None], axis=-1, mode="clip")[..., 0]
         return {"logits": logits, "nll": -jnp.mean(taken, axis=-1)}
 
 

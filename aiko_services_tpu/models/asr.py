@@ -212,7 +212,7 @@ def encode_audio(params: dict, config: AsrConfig, mel):
 
 def decode_tokens(params: dict, config: AsrConfig, tokens, memory):
     """tokens (B, T) + encoder memory -> logits (B, T, vocab)."""
-    h = jnp.take(params["token_embed"]["w"], tokens, axis=0)
+    h = jnp.take(params["token_embed"]["w"], tokens, axis=0, mode="clip")
     h = h + params["dec_positions"][:tokens.shape[1]]
 
     def dec_layer(h, layer):

@@ -389,7 +389,10 @@ def forward(params: dict, config: TransformerConfig, tokens,
         names = jax.sharding.get_abstract_mesh().axis_names
         act_spec = P("data" if "data" in names else None,
                      "seq" if "seq" in names else None, None)
-    h = jnp.take(params["embed"]["w"], tokens, axis=0)
+    # mode="clip": out-of-vocab ids clamp to the last row instead of
+    # jnp.take's default FILL mode, whose NaN embeddings silently poison
+    # every downstream activation
+    h = jnp.take(params["embed"]["w"], tokens, axis=0, mode="clip")
     if activation_specs:
         h = jax.lax.with_sharding_constraint(h, act_spec)
     positions = pos + jnp.arange(tokens.shape[1])
@@ -564,7 +567,7 @@ def make_train_step(config: TransformerConfig, optimizer,
         targets = tokens[:, 1:]
         log_probs = jax.nn.log_softmax(logits, axis=-1)
         taken = jnp.take_along_axis(
-            log_probs, targets[..., None], axis=-1)[..., 0]
+            log_probs, targets[..., None], axis=-1, mode="clip")[..., 0]
         return -jnp.mean(taken) + config.moe_aux_weight * aux
 
     @partial(jax.jit, donate_argnums=(0, 1))

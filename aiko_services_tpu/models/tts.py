@@ -93,7 +93,7 @@ def synthesize_mel(params: dict, config: TTSConfig, chars) -> jnp.ndarray:
 
     Static-duration upsampling keeps every shape known at trace time (no
     data-dependent durations -> no recompiles, scan-free decode)."""
-    h = jnp.take(params["embed"]["w"], chars, axis=0)   # (B, L, D)
+    h = jnp.take(params["embed"]["w"], chars, axis=0, mode="clip")   # (B, L, D)
     h = jnp.repeat(h, config.frames_per_char, axis=1)   # (B, T, D)
     # position-within-char phase feature lets the convs shape transients
     phase = jnp.tile(

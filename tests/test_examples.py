@@ -53,3 +53,34 @@ def test_pipeline_compute_example_runs():
         assert outputs["tensor"].shape == (8, 16)
         assert np.isfinite(outputs["tensor"]).all()
     process.terminate()
+
+
+def test_pipeline_longcontext_example_runs_scaled_down():
+    """The long-context example (sequence-parallel LM element) executes
+    on the virtual 8-device mesh; scaled-down model, same sharding
+    topology (data 1 x seq 4 x model 2)."""
+    import json
+
+    import numpy as np
+
+    with open(EXAMPLES / "pipeline_longcontext.json") as f:
+        definition = json.load(f)
+    tokens = definition["elements"][0]
+    tokens["parameters"]["data_sources"] = [[1, 64]]
+    tokens["parameters"]["count"] = 1
+    tokens["parameters"]["vocab_size"] = 128  # match the scaled lm
+    lm = definition["elements"][1]
+    lm["parameters"].update({"vocab_size": 128, "d_model": 32,
+                             "n_layers": 2, "n_heads": 4, "n_kv_heads": 2,
+                             "d_ff": 64, "max_seq_len": 128,
+                             "dtype": "float32"})
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    _, _, outputs = responses.get(timeout=120)
+    logits = np.asarray(outputs["logits"])
+    assert logits.shape == (1, 64, 128)
+    assert np.isfinite(logits).all()
+    process.terminate()
