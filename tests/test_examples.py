@@ -84,3 +84,37 @@ def test_pipeline_longcontext_example_runs_scaled_down():
     assert logits.shape == (1, 64, 128)
     assert np.isfinite(logits).all()
     process.terminate()
+
+
+def test_pipeline_longcontext_ragged_length_buckets():
+    """A context length NOT divisible by the seq axis still works: the
+    engine's bucketing pads tokens to a seq-divisible bucket and un-pads
+    the logits (causal attention makes end-padding exact)."""
+    import json
+
+    import numpy as np
+
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process
+
+    with open(EXAMPLES / "pipeline_longcontext.json") as f:
+        definition = json.load(f)
+    tokens = definition["elements"][0]
+    tokens["parameters"]["data_sources"] = [[1, 50]]  # 50 % 4 != 0
+    tokens["parameters"]["count"] = 1
+    tokens["parameters"]["vocab_size"] = 128
+    lm = definition["elements"][1]
+    lm["parameters"].update({"vocab_size": 128, "d_model": 32,
+                             "n_layers": 2, "n_heads": 4, "n_kv_heads": 2,
+                             "d_ff": 64, "max_seq_len": 128,
+                             "dtype": "float32"})
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    _, _, outputs = responses.get(timeout=120)
+    logits = np.asarray(outputs["logits"])
+    assert logits.shape == (1, 50, 128)  # un-padded back to 50
+    assert np.isfinite(logits).all()
+    process.terminate()
