@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.attention import (
-    flash_attention, ring_attention, sp_decode_attention)
+    flash_attention, ring_attention, sp_decode_attention,
+    ulysses_attention)
 from .layers import (
     apply_rotary, dense, init_dense, init_norm, repeat_kv, rms_norm,
     rotary_embedding)
@@ -51,14 +52,19 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
     dtype: str = "bfloat16"
-    # True: the long-context path.  Prefill attention runs as ring
-    # attention over the mesh "seq" axis (shard_map + ppermute) and
-    # cached DECODE runs sp_decode_attention with the cache length
-    # sharded over "seq" -- lay the cache out with
-    # cache_specs(sequence_parallel=True).  Requires an ambient
-    # jax.set_mesh holding a "seq" axis that divides the sequence length
-    # (prefill) and cache length (decode); cached prefill assumes pos=0.
+    # True: the long-context path.  Prefill attention shards over the
+    # mesh "seq" axis (mechanism below) and cached DECODE runs
+    # sp_decode_attention with the cache length sharded over "seq" --
+    # lay the cache out with cache_specs(sequence_parallel=True).
+    # Requires an ambient jax.set_mesh holding a "seq" axis that divides
+    # the sequence length (prefill) and cache length (decode); cached
+    # prefill assumes pos=0.
     sequence_parallel: bool = False
+    # "ring": KV shards rotate via ppermute (any head count; causal hops
+    # skipped).  "ulysses": all-to-all swaps seq-sharding for
+    # head-sharding and runs dense flash locally -- fewer collectives
+    # when n_heads is divisible by the seq axis.
+    sp_mechanism: str = "ring"
     # > 0: the FFN becomes a switch (top-1) mixture of experts with this
     # many experts; expert weights shard over the mesh "expert" axis
     # (param_specs), giving expert parallelism.  0 = dense FFN.
@@ -76,6 +82,12 @@ class TransformerConfig:
     # shard on the "expert" axis, where the dispatch einsum keeps weights
     # stationary and moves (tiny) tokens instead.
     moe_decode_gather: bool = True
+
+    def __post_init__(self):
+        if self.sp_mechanism not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_mechanism must be 'ring' or 'ulysses', got "
+                f"{self.sp_mechanism!r}")
 
     @property
     def head_dim(self) -> int:
@@ -211,10 +223,15 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
     k = apply_rotary(k, cos, sin)
     repeats = config.n_heads // config.n_kv_heads
 
+    def sp_prefill(q, k, v):
+        if config.sp_mechanism == "ulysses":
+            return ulysses_attention(q, k, v, mesh=None, causal=True)
+        return ring_attention(q, k, v, causal=True)
+
     if cache_k is None:
         if config.sequence_parallel:
-            out = ring_attention(q, repeat_kv(k, repeats),
-                                 repeat_kv(v, repeats), causal=True)
+            out = sp_prefill(q, repeat_kv(k, repeats),
+                             repeat_kv(v, repeats))
         else:
             out = flash_attention(q, repeat_kv(k, repeats),
                                   repeat_kv(v, repeats), causal=True)
@@ -223,10 +240,10 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
         cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
         if config.sequence_parallel and length > 1:
             # cached PREFILL (pos must be 0, the generate/prefill
-            # contract): causal ring attention over the fresh K/V --
-            # never an O(Lq x Lc) logit tensor
-            out = ring_attention(q, repeat_kv(k, repeats),
-                                 repeat_kv(v, repeats), causal=True)
+            # contract): sequence-parallel attention over the fresh K/V
+            # -- never an O(Lq x Lc) logit tensor
+            out = sp_prefill(q, repeat_kv(k, repeats),
+                             repeat_kv(v, repeats))
         elif config.sequence_parallel:
             # long-context decode: cache length sharded over the mesh
             # "seq" axis; per-device attention touches only the local

@@ -734,13 +734,16 @@ def ulysses_attention_sharded(q, k, v, axis_name: str = "seq",
     return heads_to_seq(out)
 
 
-def ulysses_attention(q, k, v, mesh, axis_name: str = "seq",
+def ulysses_attention(q, k, v, mesh=None, axis_name: str = "seq",
                       causal: bool = False, sm_scale=None):
+    """mesh=None uses the ambient mesh (callers inside jax.set_mesh,
+    e.g. the transformer's sp_mechanism=\"ulysses\" prefill)."""
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ulysses_attention_sharded, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale)
+    kwargs = {} if mesh is None else {"mesh": mesh}
     # check_vma=False: pallas_call inside shard_map can't declare varying
     # mesh axes on its ShapeDtypeStruct outputs yet
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)(q, k, v)
+        fn, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False, **kwargs)(q, k, v)
