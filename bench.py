@@ -354,11 +354,43 @@ def bench_multimodal(peak):
             "mfu": _mfu(fps * flops, peak)}, fps, p50, audio_seconds
 
 
+def _accelerator_failure(timeout: float = 120.0) -> str | None:
+    """Probe device init in a SUBPROCESS (a dead device tunnel makes
+    jax.devices() hang forever in-process, which would hang the whole
+    bench).  None = healthy; otherwise a description of the failure.
+    Skippable with AIKO_BENCH_PROBE=0 (costs one extra jax init)."""
+    if os.environ.get("AIKO_BENCH_PROBE", "1") == "0":
+        return None
+    import subprocess
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return f"device init probe timed out after {timeout:.0f}s"
+    if probe.returncode != 0:
+        tail = (probe.stderr or "").strip().splitlines()[-1:]
+        return (f"device init probe exited {probe.returncode}"
+                + (f": {tail[0]}" if tail else ""))
+    return None
+
+
 def main() -> None:
+    global SMOKE
     platform = os.environ.get("AIKO_BENCH_PLATFORM")
+    device_fallback = None
     if platform:
         import jax
         jax.config.update("jax_platforms", platform)
+    else:
+        failure = _accelerator_failure()
+        if failure is not None:
+            # accelerator down: a labeled smoke-scale CPU result beats a
+            # hang or a mid-run timeout on full-size models
+            device_fallback = f"{failure}; measured smoke-scale on CPU"
+            SMOKE = True
+            import jax
+            jax.config.update("jax_platforms", "cpu")
     import jax
 
     peak = _peak_flops_per_chip()
@@ -408,6 +440,8 @@ def main() -> None:
         "smoke": SMOKE,
         "configs": configs,
     }
+    if device_fallback:
+        result["device_fallback"] = device_fallback
     print(json.dumps(result))
     sys.stdout.flush()
     # hard-exit: skip interpreter teardown -- the tunneled device client's
