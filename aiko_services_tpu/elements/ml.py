@@ -172,18 +172,32 @@ class LMGenerate(ComputeElement):
             for row, ids in enumerate(encoded):
                 tokens[row, width - len(ids):] = ids  # left-pad
         tokens = _as_device_array(tokens, jnp.int32)
+        pad = ((self.tokenizer.pad_id or 0)
+               if self.tokenizer is not None else 0)
+        batch = tokens.shape[0]
         if self.config.sequence_parallel:
             # ring prefill shards the prompt over the seq axis: LEFT-pad
-            # the prompt up to a seq-multiple (same semantics as the
-            # batch left-padding above)
+            # the prompt up to a seq-multiple with the SAME pad id as the
+            # batch left-padding above (pad tokens are causally attended,
+            # so a divergent id would change generation vs the unsharded
+            # path for widths not divisible by the seq axis)
             seq_size = (self.mesh.shape.get("seq", 1)
                         if self.mesh is not None else 1)
             width = tokens.shape[1]
             target = ((width + seq_size - 1) // seq_size) * seq_size
             if target != width:
-                pad_block = jnp.zeros(
-                    (tokens.shape[0], target - width), jnp.int32)
+                pad_block = jnp.full(
+                    (tokens.shape[0], target - width), pad, jnp.int32)
                 tokens = jnp.concatenate([pad_block, tokens], axis=1)
+            # the seq-sharded KV cache also shards BATCH over the data
+            # axis: pad ragged batches (a single prompt is the common
+            # serving case) with dummy rows, sliced off the output below
+            data_size = (self.mesh.shape.get("data", 1)
+                         if self.mesh is not None else 1)
+            extra = (-batch) % data_size
+            if extra:
+                filler = jnp.full((extra, tokens.shape[1]), pad, jnp.int32)
+                tokens = jnp.concatenate([tokens, filler], axis=0)
         # sequence_parallel: ring prefill + sp decode run shard_map over
         # the AMBIENT mesh, and the cache must be seq-sharded
         mesh_scope = (jax.set_mesh(self.mesh) if self.mesh is not None
@@ -200,6 +214,7 @@ class LMGenerate(ComputeElement):
                 for offset, block in generate_stream(
                         self.state, self.config, tokens, max_new,
                         cache=cache, chunk=chunk):
+                    block = block[:batch]  # drop batch-padding rows
                     blocks.append(block)
                     payload = block.tolist()
                     if self.tokenizer is not None:
@@ -211,6 +226,7 @@ class LMGenerate(ComputeElement):
             else:
                 out, _ = generate(self.state, self.config, tokens,
                                   max_new, cache=cache)
+                out = out[:batch]
         result = {"generated": out}
         if self.tokenizer is not None:
             result["text"] = [self.tokenizer.decode(np.asarray(row))

@@ -24,6 +24,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.attention import (
@@ -239,9 +240,17 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
         cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
         if config.sequence_parallel and length > 1:
-            # cached PREFILL (pos must be 0, the generate/prefill
-            # contract): sequence-parallel attention over the fresh K/V
-            # -- never an O(Lq x Lc) logit tensor
+            # cached PREFILL: sequence-parallel attention over the fresh
+            # K/V only -- valid solely at pos == 0 (the generate/prefill
+            # contract); multi-token cached decode at pos > 0 would need
+            # the earlier cache shards too.  Best-effort guard: a traced
+            # pos cannot be checked at trace time, so the contract is
+            # enforceable only for concrete ints
+            if isinstance(pos, (int, np.integer)) and pos != 0:
+                raise ValueError(
+                    "sequence-parallel cached prefill requires pos == 0 "
+                    f"(got pos={pos}); multi-token cached decode at "
+                    "pos > 0 is not supported on this path")
             out = sp_prefill(q, repeat_kv(k, repeats),
                              repeat_kv(v, repeats))
         elif config.sequence_parallel:

@@ -85,8 +85,8 @@ def is_distributed() -> bool:
         return True
     try:
         from jax._src.distributed import global_state
-        return global_state.client is not None
-    except ImportError:  # pragma: no cover - jax internals moved
+        return getattr(global_state, "client", None) is not None
+    except (ImportError, AttributeError):  # pragma: no cover - internals moved
         return False
 
 

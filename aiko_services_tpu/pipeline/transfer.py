@@ -76,6 +76,18 @@ def transfer_timeout() -> float:
     return float(os.environ.get("AIKO_TRANSFER_TIMEOUT", "10"))
 
 
+def transfer_linger() -> float:
+    """How long a key stays fetchable AFTER its first fetch.  Broker
+    redelivery or a second subscriber on the hop topic (monitoring,
+    debug taps) may fetch the same descriptor; dropping the key on first
+    read would turn those into lost frames.  Kept SHORT: every delivered
+    tensor stays resident on the producer for the linger window, so at
+    steady-state streaming (frames/s x bytes/frame) the default bounds
+    extra memory to a few seconds' worth of traffic; redelivery resolves
+    well inside that."""
+    return float(os.environ.get("AIKO_TRANSFER_LINGER", "5"))
+
+
 def _advertised_host() -> str:
     """The address peers should dial: env override, else this host's
     outbound interface (UDP connect trick -- no packets sent), else the
@@ -110,8 +122,12 @@ def _resolve_dtype(name: str) -> np.dtype:
 
 class TensorTransferServer:
     """Per-process tensor side-channel: offered arrays are served by key
-    until fetched once (or until ttl expires; expiry is enforced both on
-    offer() and periodically by the accept loop)."""
+    until ttl expires.  A key stays valid for transfer_linger() seconds
+    after its first fetch (re-fetchable across broker redelivery or a
+    second hop-topic subscriber), then expires; expiry is enforced both
+    on offer() and periodically by the accept loop.  The listen interface
+    defaults to all interfaces; set AIKO_TRANSFER_BIND to restrict (the
+    key is otherwise the only access control)."""
 
     def __init__(self, host: str | None = None, port: int = 0,
                  ttl: float = 300.0):
@@ -120,7 +136,8 @@ class TensorTransferServer:
         self._lock = threading.Lock()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("0.0.0.0", int(port)))
+        bind_host = os.environ.get("AIKO_TRANSFER_BIND", "0.0.0.0")
+        self._listener.bind((bind_host, int(port)))
         self._listener.listen(16)
         self._listener.settimeout(_PURGE_INTERVAL)
         self.port = self._listener.getsockname()[1]
@@ -133,7 +150,7 @@ class TensorTransferServer:
     # -- producer side -------------------------------------------------
 
     def offer(self, array) -> dict:
-        """Stage an array for one remote fetch; returns its descriptor."""
+        """Stage an array for remote fetch; returns its descriptor."""
         array = np.ascontiguousarray(np.asarray(array))
         key = uuid.uuid4().hex
         with self._lock:
@@ -174,8 +191,17 @@ class TensorTransferServer:
                     return
                 request += chunk
             key = request.strip().decode("ascii", "replace")
+            now = time.monotonic()
             with self._lock:
-                entry = self._store.pop(key, None)
+                entry = self._store.get(key)
+                if entry is not None and entry[0] < now:
+                    del self._store[key]
+                    entry = None
+                elif entry is not None:
+                    # first fetch starts the linger clock; later fetches
+                    # within the window reuse the same (shortened) deadline
+                    deadline = min(entry[0], now + transfer_linger())
+                    self._store[key] = (deadline, entry[1])
             if entry is None:
                 conn.sendall(_HEADER.pack(0))
                 return
@@ -218,8 +244,8 @@ def fetch(descriptor: dict, timeout: float | None = None) -> np.ndarray:
             (length,) = _HEADER.unpack(header)
             if length == 0:
                 raise KeyError(
-                    f"tensor {descriptor['key']} expired or already "
-                    f"fetched from {address[0]}:{address[1]}")
+                    f"tensor {descriptor['key']} expired at "
+                    f"{address[0]}:{address[1]}")
             raw = _recv_exact(conn, length)
     except OSError as error:
         raise TransferError(

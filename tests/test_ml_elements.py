@@ -379,3 +379,64 @@ def test_lm_generate_sequence_parallel_matches_dense():
         sharding={"axes": {"data": 2, "seq": 2, "model": 2},
                   "inputs": {"tokens": ["data", None]}}))
     np.testing.assert_array_equal(sp, dense)
+
+
+def test_lm_generate_sp_text_pad_parity():
+    """Text prompts whose width does NOT divide the seq axis: the
+    sequence-parallel path left-pads to a seq-multiple with the TOKENIZER
+    pad id, so output must equal the dense model run on the identically
+    padded prompt (round-2 advisor: id-0 seq padding diverged from the
+    batch padding's pad id)."""
+    import jax
+    from aiko_services_tpu.models import (
+        BPETokenizer, TransformerConfig, generate, init_params)
+    from aiko_services_tpu.runtime import Process
+    from aiko_services_tpu.pipeline import create_pipeline
+
+    tokenizer = BPETokenizer.default()
+    prompts = ["pad parity", "pp"]
+    encoded = [tokenizer.encode(p, bos=True) for p in prompts]
+    width = max(len(ids) for ids in encoded)
+    seq_size = 2
+    assert width % seq_size != 0, (
+        f"pick prompts with max width not divisible by {seq_size} "
+        f"(got {width})")
+
+    params_def = {
+        "vocab_size": tokenizer.vocab_size, "d_model": 32, "n_layers": 2,
+        "n_heads": 4, "n_kv_heads": 2, "d_ff": 64, "max_seq_len": 64,
+        "dtype": "float32", "max_new_tokens": 6, "tokenizer": "default",
+        "sequence_parallel": True}
+    definition = {
+        "name": "sp_pad", "graph": ["(lm)"],
+        "elements": [
+            {"name": "lm", "input": [{"name": "text"}],
+             "output": [{"name": "generated"}],
+             "parameters": params_def,
+             "sharding": {"axes": {"data": 2, "seq": 2, "model": 2}},
+             "deploy": {"local": {"module": "aiko_services_tpu.elements",
+                                  "class_name": "LMGenerate"}}},
+        ]}
+    [(_, _, outputs)] = run_frames_with_data(
+        definition, {"text": prompts}, timeout=180)
+    sp_out = np.asarray(outputs["generated"])
+
+    config = TransformerConfig(
+        vocab_size=tokenizer.vocab_size, d_model=32, n_layers=2,
+        n_heads=4, n_kv_heads=2, d_ff=64, max_seq_len=64, dtype="float32")
+    params = init_params(config, jax.random.PRNGKey(0))
+    pad = tokenizer.pad_id or 0
+    target = ((width + seq_size - 1) // seq_size) * seq_size
+    padded = np.full((len(encoded), target), pad, np.int32)
+    for row, ids in enumerate(encoded):
+        padded[row, target - len(ids):] = ids
+    expected, _ = generate(params, config, padded, 6)
+    np.testing.assert_array_equal(sp_out, np.asarray(expected))
+
+    # batch 1 (the common serving case) on a data-sharded mesh: the
+    # element pads the batch to the data-axis multiple and slices it
+    # back (round-3 verify drive caught this crashing in _sp_cache)
+    [(_, _, single)] = run_frames_with_data(
+        definition, {"text": prompts[0]}, timeout=180)
+    np.testing.assert_array_equal(
+        np.asarray(single["generated"]), np.asarray(expected)[:1])

@@ -258,6 +258,22 @@ class TestRingAttentionScale:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-3, rtol=2e-3)
 
+    def test_sp_decode_collective_count(self):
+        # decode steps are collective-LATENCY bound (Lq=1 payloads are
+        # tiny): the merge must cost exactly one pmax + one fused psum,
+        # and the cache must cross the shard_map boundary un-expanded
+        # (no jnp.repeat of KV in the jaxpr)
+        from aiko_services_tpu.parallel import sp_decode_attention
+        mesh = create_mesh({"seq": 8})
+        _, k, v = _qkv(batch=1, heads=2, seq=32, dim=8, seed=8)
+        q = jax.random.normal(jax.random.PRNGKey(3), (1, 4, 1, 8),
+                              jnp.float32)
+        jaxpr = str(jax.make_jaxpr(
+            lambda q, k, v: sp_decode_attention(q, k, v, 21, mesh=mesh)
+        )(q, k, v))
+        assert jaxpr.count("psum(") + jaxpr.count("psum[") == 1, jaxpr
+        assert jaxpr.count("pmax(") + jaxpr.count("pmax[") == 1, jaxpr
+
     def test_sp_decode_composes_with_tp(self):
         from aiko_services_tpu.parallel import sp_decode_attention
         mesh = create_mesh({"data": 2, "seq": 2, "model": 2})

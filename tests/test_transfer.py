@@ -10,6 +10,7 @@ import socket
 import subprocess
 import sys
 import textwrap
+import time
 
 import numpy as np
 import pytest
@@ -33,11 +34,16 @@ class TestTransferServer:
         finally:
             server.close()
 
-    def test_fetch_is_single_shot(self):
+    def test_fetch_lingers_then_expires(self, monkeypatch):
+        # keys survive their first fetch for AIKO_TRANSFER_LINGER seconds
+        # (broker redelivery / second hop-topic subscriber), then expire
+        monkeypatch.setenv("AIKO_TRANSFER_LINGER", "1.0")
         server = TensorTransferServer()
         try:
             descriptor = server.offer(np.ones(8))
-            fetch(descriptor)
+            np.testing.assert_array_equal(fetch(descriptor), np.ones(8))
+            np.testing.assert_array_equal(fetch(descriptor), np.ones(8))
+            time.sleep(1.3)
             with pytest.raises(KeyError):
                 fetch(descriptor)
         finally:
