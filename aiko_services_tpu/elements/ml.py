@@ -196,8 +196,9 @@ class LMGenerate(ComputeElement):
                          if self.mesh is not None else 1)
             extra = (-batch) % data_size
             if extra:
-                filler = jnp.full((extra, tokens.shape[1]), pad, jnp.int32)
-                tokens = jnp.concatenate([tokens, filler], axis=0)
+                from ..utils.padding import pad_axis_to
+                tokens = pad_axis_to(tokens, 0, batch + extra,
+                                     pad_value=pad)
         # sequence_parallel: ring prefill + sp decode run shard_map over
         # the AMBIENT mesh, and the cache must be seq-sharded
         mesh_scope = (jax.set_mesh(self.mesh) if self.mesh is not None

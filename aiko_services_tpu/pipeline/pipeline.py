@@ -25,10 +25,11 @@ import time
 from ..runtime import Actor, ECProducer, Lease, ServiceFilter, ServicesCache
 from ..runtime.service import SERVICE_PROTOCOL_PIPELINE
 from ..utils import generate, get_logger, load_module
+from ..utils.padding import bucket_length, pad_axis_to
 from .definition import (
     PipelineDefinition, parse_pipeline_definition,
     validate_pipeline_definition)
-from .element import PipelineElement
+from .element import AsyncHostElement, PipelineElement
 from .stream import (
     DEFAULT_STREAM_ID, Frame, Stream, StreamEvent, StreamState)
 from .tensors import decode_frame_data, encode_frame_data
@@ -85,6 +86,10 @@ class Pipeline(Actor):
         self.elements: dict[str, object] = {}
         self._services_cache: ServicesCache | None = None
         self._remote_handlers: list = []
+        # micro-batching: frames parked per (element, stream) awaiting a
+        # coalesced flush (SURVEY.md section 7 hard-part #2: batching
+        # scheduler that still honors StreamEvent semantics)
+        self._micro_pending: dict[tuple, list] = {}
         self.share.update({
             "definition_name": definition.name,
             "element_count": len(definition.elements),
@@ -229,6 +234,9 @@ class Pipeline(Actor):
             return
         stream.destroying = True
         stream.state = state
+        for key in [key for key in self._micro_pending
+                    if key[1] == stream_id]:
+            self._micro_pending.pop(key, None)  # parked frames die with it
         lease = self._stream_leases.pop(stream_id, None)
         if lease is not None:
             lease.terminate()
@@ -384,6 +392,9 @@ class Pipeline(Actor):
                     encode_frame_data(inputs).encode("ascii"),
                 ])
                 return  # frame stays parked in stream.frames
+            if self._try_park_micro(stream, frame, node_name, element,
+                                    inputs):
+                return  # frame parked awaiting a coalesced flush
             element_start = time.perf_counter()
             stream_event, outputs = self._safe_call(
                 element.process_frame, stream, **inputs)
@@ -420,6 +431,184 @@ class Pipeline(Actor):
             frame.metrics.get("time_pipeline", 0.0)
             + time.perf_counter() - time_start)
         self._finish_frame(stream, frame)
+
+    # -- micro-batching (no reference counterpart: the reference processes
+    # one frame per mailbox message, pipeline.py:1037-1092; on TPU the MFU
+    # multiplier is coalescing queued frames into ONE jit call) ------------
+
+    @staticmethod
+    def _micro_signature(inputs: dict):
+        """Frames coalesce only when every input agrees on trailing shape
+        and dtype (the leading/batch axis may differ) and shares one
+        leading size across inputs within the frame."""
+        leading = None
+        signature = []
+        for name in sorted(inputs):
+            value = inputs[name]
+            if not hasattr(value, "shape") or getattr(value, "ndim", 0) < 1:
+                return None  # non-array input: not coalescable
+            if leading is None:
+                leading = value.shape[0]
+            elif value.shape[0] != leading:
+                return None  # inputs disagree on the batch axis
+            signature.append(
+                (name, tuple(value.shape[1:]), str(value.dtype)))
+        if leading is None:
+            return None
+        return tuple(signature)
+
+    def _try_park_micro(self, stream: Stream, frame: Frame, node_name: str,
+                        element, inputs: dict) -> bool:
+        """Park the frame for coalesced execution when the element opts in
+        (micro_batch > 1).  The flush message rides the back of the
+        pipeline mailbox, so every frame already queued parks first --
+        batch size adapts to instantaneous load (deep queue = big batch,
+        idle = batch of one, so latency stays flat when unloaded)."""
+        if isinstance(element, AsyncHostElement):
+            return False  # async elements manage their own parking
+        try:
+            micro = int(element.get_parameter("micro_batch", 1, stream) or 1)
+        except (TypeError, ValueError):
+            return False
+        if micro <= 1:
+            return False
+        signature = self._micro_signature(inputs)
+        if signature is None:
+            return False
+        key = (node_name, stream.stream_id)
+        pending = self._micro_pending.setdefault(key, [])
+        frame.paused_pe_name = node_name
+        pending.append((frame, inputs, signature))
+        if len(pending) >= micro:
+            self._flush_micro_batch(node_name, stream.stream_id)
+        elif len(pending) == 1:
+            self.post_message("_flush_micro_batch",
+                              [node_name, stream.stream_id])
+        return True
+
+    def _flush_micro_batch(self, element_name, stream_id) -> None:
+        key = (str(element_name), str(stream_id))
+        pending = self._micro_pending.pop(key, None)
+        if not pending:
+            return
+        stream = self.streams.get(str(stream_id))
+        element = self.elements.get(str(element_name))
+        if (stream is None or element is None
+                or isinstance(element, RemoteElement)):
+            return  # stream destroyed while parked: frames died with it
+        micro = max(1, int(
+            element.get_parameter("micro_batch", 1, stream) or 1))
+        while pending:
+            group = [pending.pop(0)]
+            signature = group[0][2]
+            while (pending and len(group) < micro
+                   and pending[0][2] == signature):
+                group.append(pending.pop(0))
+            self._run_micro_group(stream, element, group, micro)
+            if stream.destroying or str(stream_id) not in self.streams:
+                return  # destroyed mid-flush: remaining frames died with it
+
+    def _run_micro_group(self, stream: Stream, element, group: list,
+                         micro: int) -> None:
+        """One coalesced element call for `group` parked frames: concat
+        inputs on axis 0 -- padded by default to the FULL micro_batch row
+        count, so rampup/drain partial groups reuse the steady-state
+        compilation (micro_batch_pad_full=false falls back to
+        power-of-two buckets) -- split outputs back per frame, resume
+        each through the normal graph path."""
+        import jax.numpy as jnp
+        node_name = element.definition.name
+        rows = [next(iter(inputs.values())).shape[0]
+                for _, inputs, _ in group]
+        total = sum(rows)
+        full = rows[0] * micro
+        if element.get_parameter("micro_batch_pad_full", True, stream):
+            target = (full if total <= full
+                      else bucket_length(total, minimum=rows[0]))
+        else:
+            target = bucket_length(total, minimum=rows[0])
+        if len(group) == 1 and target == total:
+            coalesced = dict(group[0][1])
+        else:
+            coalesced = {}
+            for name in group[0][1]:
+                value = (group[0][1][name] if len(group) == 1
+                         else jnp.concatenate(
+                             [inputs[name] for _, inputs, _ in group],
+                             axis=0))
+                coalesced[name] = pad_axis_to(value, 0, target)
+        stream.current_frame_id = group[0][0].frame_id
+        element_start = time.perf_counter()
+        stream_event, outputs = self._safe_call(
+            element.process_frame, stream, **coalesced)
+        elapsed = time.perf_counter() - element_start
+        share = elapsed / len(group)
+        if stream_event == StreamEvent.PENDING:
+            if len(group) == 1:
+                # element continues off the event loop and resumes the
+                # frame via process_frame_response (frame stays parked)
+                return
+            stream_event, outputs = StreamEvent.ERROR, {
+                "diagnostic": (
+                    f"{node_name}: StreamEvent.PENDING is incompatible "
+                    f"with micro_batch > 1 (the async continuation can "
+                    f"only resume one frame); use an AsyncHostElement "
+                    f"or micro_batch: 1")}
+        if stream_event == StreamEvent.OKAY:
+            offset = 0
+            for (frame, _, _), count in zip(group, rows):
+                frame_outputs = self._split_micro_outputs(
+                    outputs or {}, offset, count, target)
+                offset += count
+                frame.metrics[f"time_{node_name}"] = (
+                    frame.metrics.get(f"time_{node_name}", 0.0) + share)
+                frame.swag.update(self._map_out(frame_outputs,
+                                                element.definition))
+                frame.paused_pe_name = None
+                self._run_frame(stream, frame, resume_after=node_name)
+                if stream.destroying or (
+                        stream.stream_id not in self.streams):
+                    return  # a resumed frame destroyed the stream
+        else:
+            # non-OKAY applies to the whole coalesced call: release every
+            # frame under the same StreamEvent policy as the inline path
+            for frame, _, _ in group:
+                frame.paused_pe_name = None
+                frame.metrics[f"time_{node_name}"] = (
+                    frame.metrics.get(f"time_{node_name}", 0.0) + share)
+            if stream_event == StreamEvent.DROP_FRAME:
+                for frame, _, _ in group:
+                    self._finish_frame(stream, frame, dropped=True)
+            elif stream_event == StreamEvent.STOP:
+                _LOGGER.info("%s: %s requested stream stop: %s",
+                             self.name, node_name, outputs)
+                for frame, _, _ in group:
+                    self._finish_frame(stream, frame)
+                self.destroy_stream(stream.stream_id, graceful=True)
+            else:
+                _LOGGER.error("%s: %s stream %s error: %s", self.name,
+                              node_name, stream.stream_id, outputs)
+                for frame, _, _ in group:
+                    self._finish_frame(stream, frame, error=True)
+                self.destroy_stream(stream.stream_id,
+                                    state=StreamState.ERROR)
+
+    @staticmethod
+    def _split_micro_outputs(outputs: dict, offset: int, count: int,
+                             total: int) -> dict:
+        """Slice one frame's rows out of a coalesced output: arrays (and
+        lists) whose leading size matches the coalesced batch split by
+        row range; anything else is shared by every frame."""
+        result = {}
+        for name, value in outputs.items():
+            if (hasattr(value, "shape") and getattr(value, "ndim", 0) >= 1
+                    and value.shape[0] == total):
+                result[name] = value[offset:offset + count]
+            elif isinstance(value, list) and len(value) == total:
+                result[name] = value[offset:offset + count]
+            else:
+                result[name] = value
+        return result
 
     def _safe_call(self, method, *args, **kwargs) -> tuple:
         try:

@@ -95,14 +95,20 @@ def _run_pipeline(definition, warmup: int, measure: int,
     process.run(in_thread=True)
     responses = queue.Queue()
     pipeline.create_stream("bench", queue_response=responses,
-                           grace_time=1800)
+                           grace_time=1800,
+                           parameters={"frame_window": 32})
     for _ in range(warmup):
         _, _, outputs = responses.get(timeout=timeout)
         jax.block_until_ready(outputs[ready_key])
     start = time.perf_counter()
     for _ in range(measure):
         _, _, outputs = responses.get(timeout=timeout)
-        jax.block_until_ready(outputs[ready_key])
+    # block ONCE on the final frame: a single device on a tunneled link
+    # executes dispatches in program order, so "last output ready" means
+    # every measured frame's compute finished -- blocking per frame would
+    # charge one ~100 ms tunnel round-trip to EVERY frame and measure the
+    # link, not the pipeline
+    jax.block_until_ready(outputs[ready_key])
     elapsed = time.perf_counter() - start
     pipeline.destroy_stream("bench")
 
@@ -118,8 +124,11 @@ def _run_pipeline(definition, warmup: int, measure: int,
             latencies.append(time.time() - lat_outputs["t0"])
     pipeline.destroy_stream("latency")
     process.terminate()
-    p50 = (float(np.percentile(latencies[1:] or latencies, 50))
-           if latencies else elapsed / measure)
+    # a stage that drops "t0" would silently degrade p50 into a
+    # throughput-derived estimate -- fail loudly instead
+    assert latencies, (
+        "no t0 timestamps reached the response: latency was not measured")
+    p50 = float(np.percentile(latencies[1:] or latencies, 50))
     return measure / elapsed, p50, outputs
 
 
@@ -272,41 +281,84 @@ def bench_llm(peak):
     elapsed = time.perf_counter() - start
     tokens_per_sec = produced * batch / elapsed
     decode_flops = transformer_flops_per_token(config, prompt_len)
+
+    # batch-scaling rows: decode throughput vs batch (serving headroom --
+    # decode is HBM-bound, so tokens/sec should scale with batch until
+    # the KV cache saturates bandwidth)
+    scaling = {}
+    for scale_batch in ((2,) if SMOKE else (16, 64)):
+        scale_prompt = jnp.ones((scale_batch, prompt_len), jnp.int32)
+        for _ in generate_stream(params, config, scale_prompt, max_new,
+                                 chunk=chunk):
+            pass  # compile at this batch
+        scale_start = time.perf_counter()
+        scale_produced = 0
+        for _, block in generate_stream(params, config, scale_prompt,
+                                        max_new, chunk=chunk):
+            scale_produced += block.shape[1]
+        scaling[f"batch_{scale_batch}"] = round(
+            scale_produced * scale_batch
+            / (time.perf_counter() - scale_start), 1)
     return {"model": f"{name} ({n_params / 1e6:.0f}M params)",
             "batch": batch,
             "prompt_len": prompt_len,
             "time_to_first_token_ms": round(ttft * 1000, 1),
             "tokens_per_sec": round(tokens_per_sec, 1),
+            "tokens_per_sec_by_batch": scaling,
             "decode_mfu": _mfu(tokens_per_sec * decode_flops, peak)}
 
 
 # -- config 5: 3-stage multi-modal pipeline ---------------------------------
 
 def bench_multimodal(peak):
+    """BASELINE config 5 at the NAMED reference-scale stages: the
+    whisper_small ASR preset, the llama32_1b LM, and the yolov8n 640 px
+    detector -- the same model configs benched individually as configs
+    2/3/4 (SMOKE shrinks everything for CPU runs).  Each frame carries
+    `batch` audio windows + images; micro_batch coalesces queued frames
+    into one jit call per stage."""
     from aiko_services_tpu.models import (
         asr_flops_per_example, detector_flops_per_image,
         transformer_flops_per_token)
-    from aiko_services_tpu.models.configs import WHISPER_TINY
+    from aiko_services_tpu.models import configs as model_configs
+    from aiko_services_tpu.models.asr import AsrConfig
     from aiko_services_tpu.models.detector import DetectorConfig
     from aiko_services_tpu.models.transformer import TransformerConfig
 
     warmup, measure = (2, 8) if SMOKE else (10, 120)
     # 5 s chunks = the reference speech cadence (audio_io.py:455-460)
     audio_seconds = 1.0 if SMOKE else 5.0
-    image_size = 64 if SMOKE else 256
-    lm = dict(vocab_size=1024, d_model=256 if SMOKE else 512,
-              n_layers=2 if SMOKE else 8, n_heads=8, n_kv_heads=4,
-              d_ff=768 if SMOKE else 1536, max_seq_len=2048,
-              dtype="float32" if SMOKE else "bfloat16")
-    asr = dict(d_model=WHISPER_TINY.d_model if not SMOKE else 64,
-               enc_layers=4 if not SMOKE else 1,
-               dec_layers=4 if not SMOKE else 1,
-               n_heads=6 if not SMOKE else 2, vocab_size=1024,
-               max_tokens=16, max_frames=192 if SMOKE else 512,
-               dtype="float32" if SMOKE else "bfloat16")
-    det = dict(n_classes=16, base_channels=8 if SMOKE else 32,
-               image_size=image_size,
-               dtype="float32" if SMOKE else "bfloat16")
+    batch = 1 if SMOKE else 2  # rows per frame (data_batch_size)
+    micro = 1 if SMOKE else 4  # frames coalesced per jit call
+    max_tokens = 16
+    if SMOKE:
+        image_size = 64
+        lm = dict(vocab_size=1024, d_model=256, n_layers=2, n_heads=8,
+                  n_kv_heads=4, d_ff=768, max_seq_len=2048,
+                  dtype="float32")
+        asr = dict(d_model=64, enc_layers=1, dec_layers=1, n_heads=2,
+                   vocab_size=1024, max_tokens=max_tokens, max_frames=192,
+                   dtype="float32")
+        det = dict(n_classes=16, base_channels=8, image_size=image_size,
+                   dtype="float32")
+        asr_config = AsrConfig(**{k: v for k, v in asr.items()
+                                  if k != "max_tokens"})
+        lm_config = TransformerConfig(**lm)
+        det_config = DetectorConfig(**det)
+    else:
+        # the flagship presets, by name (BASELINE.md config 5)
+        asr = {"preset": "whisper_small", "max_frames": 512,
+               "max_tokens": max_tokens, "dtype": "bfloat16",
+               "micro_batch": micro}
+        lm = {"preset": "llama32_1b", "dtype": "bfloat16",
+              "micro_batch": micro}
+        det = {"preset": "yolov8n", "dtype": "bfloat16",
+               "micro_batch": micro}
+        from dataclasses import replace
+        asr_config = replace(model_configs.WHISPER_SMALL, max_frames=512)
+        lm_config = model_configs.LLAMA32_1B
+        det_config = model_configs.YOLOV8N_SHAPE
+        image_size = det_config.image_size
     definition = {
         "name": "bench_multimodal",
         "graph": ["(sources (asr (text) (lm)) (detector))"],
@@ -316,6 +368,7 @@ def bench_multimodal(peak):
                         {"name": "t0"}],
              "parameters": {"data_sources": [[440, audio_seconds]],
                             "image_shape": [3, image_size, image_size],
+                            "data_batch_size": batch,
                             "timestamps": True, "on_device": ON_DEVICE,
                             "count": warmup + measure + 4},
              "deploy": _local("MultiModalSource")},
@@ -324,6 +377,7 @@ def bench_multimodal(peak):
              "parameters": asr, "deploy": _local("SpeechToText")},
             {"name": "text", "input": [{"name": "tokens"}],
              "output": [{"name": "text"}],
+             "parameters": {"workers": 16},
              "deploy": _local("TokensToText")},
             {"name": "lm", "input": [{"name": "tokens"}],
              "output": [{"name": "logits"}, {"name": "nll"}],
@@ -335,23 +389,23 @@ def bench_multimodal(peak):
     }
     fps, p50, _ = _run_pipeline(definition, warmup=warmup, measure=measure,
                                 ready_key="detections")
-    # per-frame compute across the three model stages
-    from aiko_services_tpu.models.asr import AsrConfig
-    asr_config = AsrConfig(**{k: v for k, v in asr.items()
-                              if k not in ("max_tokens",)})
-    lm_config = TransformerConfig(**lm)
-    det_config = DetectorConfig(**det)
+    # per-frame compute across the three model stages (batch rows each)
     n_frames = int(audio_seconds * 100) // 2
-    lm_tokens = asr["max_tokens"]
-    flops = (asr_flops_per_example(asr_config, n_frames, lm_tokens)
-             + transformer_flops_per_token(lm_config, lm_tokens) * lm_tokens
-             + detector_flops_per_image(det_config))
+    flops = batch * (
+        asr_flops_per_example(asr_config, n_frames, max_tokens)
+        + transformer_flops_per_token(lm_config, max_tokens) * max_tokens
+        + detector_flops_per_image(det_config))
     return {"frames_per_sec_chip": round(fps, 2),
             "p50_ms": round(p50 * 1000, 2),
             "audio_seconds_per_frame": audio_seconds,
-            "audio_realtime_factor": round(fps * audio_seconds, 2),
-            "stages": "speech->(text,lm) + vision->detections",
-            "mfu": _mfu(fps * flops, peak)}, fps, p50, audio_seconds
+            "rows_per_frame": batch,
+            "audio_realtime_factor": round(
+                fps * batch * audio_seconds, 2),
+            "stages": ("whisper_small -> (text, llama32_1b) + "
+                       "yolov8n-640 -> detections" if not SMOKE else
+                       "speech->(text,lm) + vision->detections (smoke)"),
+            "micro_batch": micro,
+            "mfu": _mfu(fps * flops, peak)}, fps, p50, audio_seconds, batch
 
 
 def _accelerator_failure(timeout: float = 120.0) -> str | None:
@@ -406,9 +460,10 @@ def main() -> None:
     if "llm" in wanted:
         configs["llm"] = bench_llm(peak)
     headline_fps, headline_p50, audio_seconds = None, None, None
+    headline_rows = 1
     if "pipeline" in wanted:
         (configs["pipeline_multimodal"], headline_fps, headline_p50,
-         audio_seconds) = bench_multimodal(peak)
+         audio_seconds, headline_rows) = bench_multimodal(peak)
     if headline_fps is None:  # subset run: headline from first config
         first = next(iter(configs.values()))
         headline_fps = (first.get("frames_per_sec_chip")
@@ -426,7 +481,7 @@ def main() -> None:
         # realtime, speech_elements.py:186-192 relative-speed table --
         # generous to the reference: its LLM + YOLO stages are free here)
         "vs_baseline": (
-            round(headline_fps * audio_seconds
+            round(headline_fps * headline_rows * audio_seconds
                   / REFERENCE_GPU_SPEECH_REALTIME, 2)
             if audio_seconds is not None
             else round(headline_fps / REFERENCE_FRAMES_PER_SEC, 2)),

@@ -4,8 +4,8 @@ import time
 import pytest
 
 from aiko_services_tpu.pipeline import (
-    AsyncHostElement, DefinitionError, StreamState, create_pipeline,
-    parse_pipeline_definition)
+    AsyncHostElement, DefinitionError, PipelineElement, StreamEvent,
+    StreamState, create_pipeline, parse_pipeline_definition)
 from aiko_services_tpu.runtime import Process, Registrar
 from aiko_services_tpu.transport import reset_brokers
 from helpers import wait_for
@@ -417,4 +417,133 @@ def test_async_host_element_error_releases_frame():
                       or not pipeline.streams["s1"].frames), timeout=10)
     stream = pipeline.streams.get("s1")
     assert stream is None or not stream.frames  # no parked-frame leak
+    process.terminate()
+
+
+# -- micro-batching ----------------------------------------------------------
+
+class BatchRecorder(PipelineElement):
+    """Multiplies x by 10; records the leading (batch) size of every call
+    on the stream (shared with the test; load_module imports a second
+    copy of this module, so class attributes are NOT shared)."""
+
+    def process_frame(self, stream, x):
+        stream.variables.setdefault("batches", []).append(int(x.shape[0]))
+        return StreamEvent.OKAY, {
+            "y": x * 10, "tag": "shared",
+            "labels": [f"row{i}" for i in range(x.shape[0])]}
+
+
+class ExplodingBatcher(PipelineElement):
+    def process_frame(self, stream, x):
+        raise RuntimeError("bad batch")
+
+
+def _micro_definition(micro_batch, class_name="BatchRecorder",
+                      pad_full=True):
+    return {
+        "name": "micro_pipe",
+        "graph": ["(batcher)"],
+        "elements": [
+            {"name": "batcher", "input": [{"name": "x"}],
+             "output": [{"name": "y"}, {"name": "labels"},
+                        {"name": "tag"}],
+             "parameters": {"micro_batch": micro_batch,
+                            "micro_batch_pad_full": pad_full},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": class_name}}},
+        ],
+    }
+
+
+def test_micro_batch_coalesces_queued_frames():
+    """12 frames queued ahead of the event loop coalesce into 2 jit-sized
+    calls (8-frame cap, then the 4 remaining), each frame getting exactly
+    its own rows back."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, _micro_definition(micro_batch=8))
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    for index in range(12):  # queued BEFORE the loop starts: all park
+        pipeline.create_frame(
+            stream, {"x": np.full((2, 3), float(index), np.float32)})
+    process.run(in_thread=True)
+    got = {}
+    for _ in range(12):
+        _, frame, outputs = responses.get(timeout=10)
+        got[frame.frame_id] = outputs
+    assert sorted(got) == list(range(12))
+    for index in range(12):
+        value = np.asarray(got[index]["y"])
+        assert value.shape == (2, 3)
+        assert float(value[0, 0]) == index * 10  # own rows, not a neighbor's
+        assert got[index]["tag"] == "shared"  # non-batch output shared
+        pos = index if index < 8 else index - 8  # row slice within group
+        assert got[index]["labels"] == [f"row{2 * pos}", f"row{2 * pos + 1}"]
+    # both groups pad to the FULL micro_batch rows (8 frames x 2 = 16):
+    # the 4-frame remainder reuses the steady-state compilation
+    assert stream.variables["batches"] == [16, 16], stream.variables
+    assert "s1" not in pipeline.streams or not pipeline.streams["s1"].frames
+    process.terminate()
+
+
+def test_micro_batch_single_frame_latency_path():
+    """An unloaded stream must run batches of one (no waiting for more
+    frames); with pad_full off the call is genuinely single-row."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(
+        process, _micro_definition(micro_batch=8, pad_full=False))
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    for index in range(3):
+        pipeline.create_frame(
+            stream, {"x": np.ones((1, 3), np.float32) * index})
+        _, frame, outputs = responses.get(timeout=10)
+        assert float(np.asarray(outputs["y"])[0, 0]) == index * 10
+    assert stream.variables["batches"] == [1, 1, 1], stream.variables
+    process.terminate()
+
+
+def test_micro_batch_error_releases_all_parked_frames():
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(
+        process, _micro_definition(micro_batch=4,
+                                   class_name="ExplodingBatcher"))
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    for _ in range(3):
+        pipeline.create_frame(stream, {"x": np.zeros((2, 2), np.float32)})
+    process.run(in_thread=True)
+    wait_for(lambda: ("s1" not in pipeline.streams
+                      or not pipeline.streams["s1"].frames), timeout=10)
+    stream = pipeline.streams.get("s1")
+    assert stream is None or not stream.frames  # no parked-frame leak
+    assert not pipeline._micro_pending
+    process.terminate()
+
+
+def test_micro_batch_mixed_shapes_group_separately():
+    """Frames whose trailing shapes differ must not concatenate."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, _micro_definition(micro_batch=8))
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    shapes = [(2, 3), (2, 3), (2, 5), (2, 5), (2, 3)]
+    for index, shape in enumerate(shapes):
+        pipeline.create_frame(
+            stream, {"x": np.full(shape, float(index), np.float32)})
+    process.run(in_thread=True)
+    seen = {}
+    for _ in range(len(shapes)):
+        _, frame, outputs = responses.get(timeout=10)
+        seen[frame.frame_id] = np.asarray(outputs["y"]).shape
+    assert seen == {0: (2, 3), 1: (2, 3), 2: (2, 5), 3: (2, 5), 4: (2, 3)}
+    # consecutive same-shape runs coalesce: [0,1] [2,3] [4], each padded
+    # to the full 16 rows (one compilation per trailing shape)
+    assert stream.variables["batches"] == [16, 16, 16], stream.variables
     process.terminate()
