@@ -267,6 +267,7 @@ class SpeechToText(ComputeElement):
                                       max_frames=int(max_frames))
         else:
             self.config = AsrConfig(
+                n_mels=int(self.get_parameter("n_mels", 80)),
                 d_model=int(self.get_parameter("d_model", 384)),
                 enc_layers=int(self.get_parameter("enc_layers", 4)),
                 dec_layers=int(self.get_parameter("dec_layers", 4)),
@@ -277,7 +278,25 @@ class SpeechToText(ComputeElement):
             )
         weights = self.get_parameter("weights")
         if weights:
-            params = load_pytree(weights, dtype=self.config.dtype)
+            # probe the container: HF openai/whisper-* naming loads
+            # through the whisper name-map (pretrained transcription,
+            # reference speech_elements.py:229-262); otherwise the
+            # framework's own save_pytree layout
+            from ..models import SafetensorsFile, load_whisper_params
+            probe = SafetensorsFile(weights)
+            is_hf = "model.encoder.conv1.weight" in probe
+            probe.close()
+            if is_hf:
+                # HF whisper decodes between the real special tokens
+                # (<|startoftranscript|> 50258, <|endoftext|> 50257);
+                # native checkpoints keep the config's own ids
+                self.config = replace(
+                    self.config,
+                    sot_token=int(self.get_parameter("sot_token", 50258)),
+                    eot_token=int(self.get_parameter("eot_token", 50257)))
+                params = load_whisper_params(weights, self.config)
+            else:
+                params = load_pytree(weights, dtype=self.config.dtype)
         else:
             params = init_asr_params(
                 self.config,
@@ -292,7 +311,7 @@ class SpeechToText(ComputeElement):
         if audio.ndim == 1:
             audio = audio[None]
         max_tokens = int(self.get_parameter("max_tokens", 32, stream))
-        mel = log_mel_spectrogram(audio)
+        mel = log_mel_spectrogram(audio, n_mels=self.config.n_mels)
         tokens = transcribe(self.state, self.config, mel,
                             max_tokens=max_tokens)
         return StreamEvent.OKAY, {"tokens": tokens}
@@ -404,7 +423,35 @@ class Detector(ComputeElement):
     "rectangles": [...]}) -- detections stay on device; the overlay dict is
     produced lazily by ImageOverlay/host sinks."""
 
-    def setup(self):
+    def _configure(self) -> None:
+        """Idempotent config construction, shared by setup() and the
+        checkpoint-restore path (restore_state installs state WITHOUT
+        calling setup, tpu_element.py).  Probes the weights container:
+        ultralytics YOLOv8 naming selects the REAL v8 architecture
+        (models/yolo.py, BN folded), matching the reference's
+        pretrained-YOLO capability (yolo.py:51-54)."""
+        if hasattr(self, "config"):
+            return
+        self._yolo = False
+        weights = self.get_parameter("weights")
+        if weights:
+            from ..models import SafetensorsFile
+            probe = SafetensorsFile(weights)
+            self._yolo = ("model.0.conv.weight" in probe
+                          or "model.model.0.conv.weight" in probe)
+            probe.close()
+        if self._yolo:
+            from ..models import YOLOV8N
+            self.config = replace(
+                YOLOV8N,
+                n_classes=int(self.get_parameter("n_classes", 80)),
+                image_size=int(self.get_parameter("image_size", 640)),
+                max_detections=int(
+                    self.get_parameter("max_detections", 300)),
+                score_threshold=float(
+                    self.get_parameter("score_threshold", 0.25)),
+                dtype=str(self.get_parameter("dtype", "bfloat16")))
+            return
         preset = self.get_parameter("preset")
         if preset:
             self.config = _DETECTOR_PRESETS[str(preset)]
@@ -422,7 +469,16 @@ class Detector(ComputeElement):
                     self.get_parameter("score_threshold", 0.25)),
                 dtype=str(self.get_parameter("dtype", "bfloat16")),
             )
+
+    def setup(self):
+        self._configure()
         weights = self.get_parameter("weights")
+        if self._yolo:
+            from ..models import load_yolov8_params
+            params = load_yolov8_params(weights, self.config)
+            _LOGGER.info("%s: yolov8 %.1fM params (BN folded)",
+                         self.definition.name, count_params(params) / 1e6)
+            return params
         if weights:
             params = load_pytree(weights, dtype=self.config.dtype)
         else:
@@ -435,8 +491,13 @@ class Detector(ComputeElement):
 
     def process_frame(self, stream, image):
         self._ensure_ready()
+        self._configure()  # restore_state path never ran setup()
         image = _as_device_array(image, jnp.float32)
         if image.ndim == 3:
             image = image[None]
-        detections = detect(self.state, self.config, image)
+        if self._yolo:
+            from ..models import yolo_detect
+            detections = yolo_detect(self.state, self.config, image)
+        else:
+            detections = detect(self.state, self.config, image)
         return StreamEvent.OKAY, {"detections": detections}

@@ -5,7 +5,7 @@ from .transformer import (                                    # noqa: F401
 from .tokenizer import BPETokenizer, train_bpe                # noqa: F401
 from .weights import (                                        # noqa: F401
     read_safetensors, write_safetensors, SafetensorsFile, save_pytree,
-    load_pytree, load_llama_params)
+    load_pytree, load_llama_params, load_whisper_params)
 from .configs import (                                        # noqa: F401
     LLAMA3_8B, LLAMA32_1B, LM_TOY, WHISPER_TINY, WHISPER_SMALL,
     YOLOV8N_SHAPE, DETECTOR_TOY, transformer_flops_per_token,
@@ -16,3 +16,6 @@ from .asr import (                                            # noqa: F401
 from .detector import (                                       # noqa: F401
     DetectorConfig, init_detector_params, detect, detector_forward,
     decode_boxes, non_max_suppression)
+from .yolo import (                                           # noqa: F401
+    YoloV8Config, YOLOV8N, init_yolo_params, load_yolov8_params,
+    yolo_forward, yolo_detect)

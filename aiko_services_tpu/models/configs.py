@@ -42,7 +42,10 @@ LM_TOY = TransformerConfig(
     d_ff=768, max_seq_len=512, dtype="float32")
 
 # Whisper ladder shapes (reference speech_elements.py:186-192:
-# tiny 39M 32x ... small 244M 6x); multilingual vocab 51865
+# tiny 39M 32x ... small 244M 6x); multilingual vocab 51865.  Special
+# token ids keep the AsrConfig defaults (sot 1 / eot 2) so natively
+# trained checkpoints decode unchanged; SpeechToText switches to the real
+# HF ids (50258/50257) only when an HF checkpoint is ingested.
 WHISPER_TINY = AsrConfig(
     n_mels=80, d_model=384, enc_layers=4, dec_layers=4, n_heads=6,
     vocab_size=51865, max_frames=1500, max_text_len=448, dtype="bfloat16")

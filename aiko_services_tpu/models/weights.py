@@ -19,6 +19,7 @@
 
 from __future__ import annotations
 
+import contextlib
 import json
 import mmap
 from pathlib import Path
@@ -29,6 +30,7 @@ import ml_dtypes
 __all__ = [
     "read_safetensors", "write_safetensors", "SafetensorsFile",
     "save_pytree", "load_pytree", "load_llama_params", "llama_name_map",
+    "load_whisper_params", "whisper_layer_map",
 ]
 
 _DTYPES = {
@@ -147,6 +149,30 @@ def load_pytree(path, dtype=None) -> dict:
     return tree
 
 
+@contextlib.contextmanager
+def open_checkpoint(paths):
+    """Multi-shard safetensors index shared by the checkpoint loaders:
+    yields (index, raw) where index maps tensor name -> reader and
+    raw(name) materializes a tensor (KeyError names the missing tensor).
+    Readers are closed even when a load fails partway."""
+    if isinstance(paths, (str, Path)):
+        paths = [paths]
+    readers = [SafetensorsFile(path) for path in paths]
+    index = {name: reader for reader in readers for name in reader.keys()}
+
+    def raw(name: str) -> np.ndarray:
+        reader = index.get(name)
+        if reader is None:
+            raise KeyError(f"Checkpoint is missing tensor: {name}")
+        return reader.get(name)
+
+    try:
+        yield index, raw
+    finally:
+        for reader in readers:
+            reader.close()
+
+
 # -- HuggingFace Llama naming -> framework pytree ---------------------------
 
 def llama_name_map(layer: int) -> dict:
@@ -183,17 +209,18 @@ def load_llama_params(paths, config, mesh=None, specs=None):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    if isinstance(paths, (str, Path)):
-        paths = [paths]
-    readers = [SafetensorsFile(path) for path in paths]
-    index = {name: reader for reader in readers for name in reader.keys()}
     dtype = np.dtype(config.dtype)
+    with open_checkpoint(paths) as (index, raw):
+        return _load_llama_indexed(index, raw, config, mesh, specs, dtype)
+
+
+def _load_llama_indexed(index, raw, config, mesh, specs, dtype):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
 
     def fetch(name, transpose=False):
-        reader = index.get(name)
-        if reader is None:
-            raise KeyError(f"Checkpoint is missing tensor: {name}")
-        array = reader.get(name)
+        array = raw(name)
         if transpose:
             array = array.T
         return np.ascontiguousarray(array).astype(dtype, copy=False)
@@ -249,6 +276,125 @@ def load_llama_params(paths, config, mesh=None, specs=None):
         stacked_layers = jax.tree_util.tree_map(jnp.asarray,
                                                 stacked_layers)
     params["layers"] = stacked_layers
-    for reader in readers:
-        reader.close()
     return params
+
+
+# -- HuggingFace Whisper naming -> framework ASR pytree ----------------------
+
+def _attention_map(hf_prefix: str, ours: str) -> dict:
+    """One whisper attention block: q/v/out projections carry biases,
+    k_proj does not (HF WhisperAttention)."""
+    return {
+        hf_prefix + "q_proj.weight": ((ours, "wq", "w"), True),
+        hf_prefix + "q_proj.bias": ((ours, "wq", "b"), False),
+        hf_prefix + "k_proj.weight": ((ours, "wk", "w"), True),
+        hf_prefix + "v_proj.weight": ((ours, "wv", "w"), True),
+        hf_prefix + "v_proj.bias": ((ours, "wv", "b"), False),
+        hf_prefix + "out_proj.weight": ((ours, "wo", "w"), True),
+        hf_prefix + "out_proj.bias": ((ours, "wo", "b"), False),
+    }
+
+
+def whisper_layer_map(layer: int, decoder: bool) -> dict:
+    """HF tensor name -> (pytree path under enc_layers/dec_layers,
+    transpose?) for one whisper transformer layer.  Linear weights are
+    (out, in) in HF and (in, out) here; layer norms carry weight+bias
+    (models/asr.py pre-LN blocks apply both)."""
+    side = "decoder" if decoder else "encoder"
+    prefix = f"model.{side}.layers.{layer}."
+    mapping = {
+        prefix + "fc1.weight": (("mlp", "w1", "w"), True),
+        prefix + "fc1.bias": (("mlp", "w1", "b"), False),
+        prefix + "fc2.weight": (("mlp", "w2", "w"), True),
+        prefix + "fc2.bias": (("mlp", "w2", "b"), False),
+        prefix + "final_layer_norm.weight": (("mlp_norm", "scale"), False),
+        prefix + "final_layer_norm.bias": (("mlp_norm", "bias"), False),
+    }
+    if decoder:
+        mapping.update(_attention_map(prefix + "self_attn.", "self"))
+        mapping.update(_attention_map(prefix + "encoder_attn.", "cross"))
+        mapping.update({
+            prefix + "self_attn_layer_norm.weight": (
+                ("self_norm", "scale"), False),
+            prefix + "self_attn_layer_norm.bias": (
+                ("self_norm", "bias"), False),
+            prefix + "encoder_attn_layer_norm.weight": (
+                ("cross_norm", "scale"), False),
+            prefix + "encoder_attn_layer_norm.bias": (
+                ("cross_norm", "bias"), False),
+        })
+    else:
+        mapping.update(_attention_map(prefix + "self_attn.", "attn"))
+        mapping.update({
+            prefix + "self_attn_layer_norm.weight": (
+                ("attn_norm", "scale"), False),
+            prefix + "self_attn_layer_norm.bias": (
+                ("attn_norm", "bias"), False),
+        })
+    return mapping
+
+
+def load_whisper_params(paths, config) -> dict:
+    """Build the AsrConfig pytree from HuggingFace openai/whisper-*
+    safetensors naming (capability parity with the reference's pretrained
+    WhisperX element, reference speech_elements.py:229-262 -- here the
+    checkpoint feeds the in-framework encoder-decoder, models/asr.py).
+
+    Layout notes: HF conv1/conv2 weights are (d_model, in, kernel),
+    exactly this framework's _conv1d layout; positional tables are sliced
+    to config.max_frames / config.max_text_len (shorter serving windows
+    read a prefix of the 30 s table); the output head is tied to
+    model.decoder.embed_tokens (HF WhisperForConditionalGeneration ties
+    proj_out the same way)."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(config.dtype)
+    with open_checkpoint(paths) as (index, raw):
+        return _load_whisper_indexed(raw, config, dtype)
+
+
+def _load_whisper_indexed(raw, config, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    def fetch(name, transpose=False):
+        array = raw(name)
+        if transpose:
+            array = array.T
+        return np.ascontiguousarray(array).astype(dtype, copy=False)
+
+    def build_layers(count, decoder):
+        per_layer = []
+        for layer in range(count):
+            layer_params: dict = {}
+            for hf_name, (parts, transpose) in whisper_layer_map(
+                    layer, decoder).items():
+                node = layer_params
+                for part in parts[:-1]:
+                    node = node.setdefault(part, {})
+                node[parts[-1]] = fetch(hf_name, transpose)
+            per_layer.append(layer_params)
+        return jax.tree_util.tree_map(
+            lambda *leaves: jnp.asarray(np.stack(leaves)), *per_layer)
+
+    params = {
+        "conv1": {"w": fetch("model.encoder.conv1.weight"),
+                  "b": fetch("model.encoder.conv1.bias")},
+        "conv2": {"w": fetch("model.encoder.conv2.weight"),
+                  "b": fetch("model.encoder.conv2.bias")},
+        "enc_positions": fetch(
+            "model.encoder.embed_positions.weight")[:config.max_frames],
+        "enc_layers": build_layers(config.enc_layers, decoder=False),
+        "enc_norm": {
+            "scale": fetch("model.encoder.layer_norm.weight"),
+            "bias": fetch("model.encoder.layer_norm.bias")},
+        "token_embed": {"w": fetch("model.decoder.embed_tokens.weight")},
+        "dec_positions": fetch(
+            "model.decoder.embed_positions.weight")[:config.max_text_len],
+        "dec_layers": build_layers(config.dec_layers, decoder=True),
+        "dec_norm": {
+            "scale": fetch("model.decoder.layer_norm.weight"),
+            "bias": fetch("model.decoder.layer_norm.bias")},
+    }
+    return jax.tree_util.tree_map(jnp.asarray, params)

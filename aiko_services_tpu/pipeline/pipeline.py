@@ -593,12 +593,14 @@ class Pipeline(Actor):
                 self.destroy_stream(stream.stream_id,
                                     state=StreamState.ERROR)
 
-    @staticmethod
-    def _split_micro_outputs(outputs: dict, offset: int, count: int,
+    @classmethod
+    def _split_micro_outputs(cls, outputs: dict, offset: int, count: int,
                              total: int) -> dict:
         """Slice one frame's rows out of a coalesced output: arrays (and
         lists) whose leading size matches the coalesced batch split by
-        row range; anything else is shared by every frame."""
+        row range, recursing into nested dicts (e.g. the Detector's
+        {"detections": {boxes, scores, ...}} contract); anything else is
+        shared by every frame."""
         result = {}
         for name, value in outputs.items():
             if (hasattr(value, "shape") and getattr(value, "ndim", 0) >= 1
@@ -606,6 +608,9 @@ class Pipeline(Actor):
                 result[name] = value[offset:offset + count]
             elif isinstance(value, list) and len(value) == total:
                 result[name] = value[offset:offset + count]
+            elif isinstance(value, dict):
+                result[name] = cls._split_micro_outputs(
+                    value, offset, count, total)
             else:
                 result[name] = value
         return result

@@ -431,6 +431,7 @@ class BatchRecorder(PipelineElement):
         stream.variables.setdefault("batches", []).append(int(x.shape[0]))
         return StreamEvent.OKAY, {
             "y": x * 10, "tag": "shared",
+            "nested": {"z": x + 1, "count": int(x.shape[0])},
             "labels": [f"row{i}" for i in range(x.shape[0])]}
 
 
@@ -447,7 +448,7 @@ def _micro_definition(micro_batch, class_name="BatchRecorder",
         "elements": [
             {"name": "batcher", "input": [{"name": "x"}],
              "output": [{"name": "y"}, {"name": "labels"},
-                        {"name": "tag"}],
+                        {"name": "tag"}, {"name": "nested"}],
              "parameters": {"micro_batch": micro_batch,
                             "micro_batch_pad_full": pad_full},
              "deploy": {"local": {"module": "tests.test_pipeline",
@@ -479,6 +480,9 @@ def test_micro_batch_coalesces_queued_frames():
         assert value.shape == (2, 3)
         assert float(value[0, 0]) == index * 10  # own rows, not a neighbor's
         assert got[index]["tag"] == "shared"  # non-batch output shared
+        nested = got[index]["nested"]  # dicts split recursively per frame
+        assert np.asarray(nested["z"]).shape == (2, 3)
+        assert float(np.asarray(nested["z"])[0, 0]) == index + 1
         pos = index if index < 8 else index - 8  # row slice within group
         assert got[index]["labels"] == [f"row{2 * pos}", f"row{2 * pos + 1}"]
     # both groups pad to the FULL micro_batch rows (8 frames x 2 = 16):
