@@ -10,7 +10,7 @@ from .compute import (                                        # noqa: F401
     ArraySource, TokenSource, MultiModalSource, JaxScale, JaxMLP, ToHost)
 from .ml import (                                             # noqa: F401
     LMForward, LMGenerate, SpeechToText, TextToSpeech, Detector,
-    TokensToText, TextToTokens)
+    DetectionsPublish, TokensToText, TextToTokens)
 from .vision import FaceDetect, ArucoDetect                   # noqa: F401
 from .robot import RobotActor, RobotControl, parse_actions    # noqa: F401
 from .image_io import (                                       # noqa: F401

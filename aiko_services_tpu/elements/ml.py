@@ -27,7 +27,8 @@ from ..pipeline import (
 from ..utils import get_logger
 
 __all__ = ["LMForward", "LMGenerate", "SpeechToText", "TextToSpeech",
-           "Detector", "TokensToText", "TextToTokens"]
+           "Detector", "DetectionsPublish", "TokensToText",
+           "TextToTokens"]
 
 _LOGGER = get_logger("ml_elements")
 
@@ -83,8 +84,7 @@ def _load_transformer_params(element, config: TransformerConfig):
     weights = element.get_parameter("weights")
     if weights:
         paths = weights if isinstance(weights, list) else [weights]
-        from ..models import SafetensorsFile
-        probe = SafetensorsFile(paths[0])
+        probe = _probe_weight_names(weights)
         is_hf = "model.embed_tokens.weight" in probe
         probe.close()
         if is_hf:
@@ -92,6 +92,15 @@ def _load_transformer_params(element, config: TransformerConfig):
         return load_pytree(paths[0], dtype=config.dtype)
     return init_params(
         config, jax.random.PRNGKey(int(element.get_parameter("seed", 0))))
+
+
+def _probe_weight_names(weights) -> "SafetensorsFile":
+    """Container probe for format detection: opens the FIRST shard when
+    weights is a list (shards share one naming convention).  Caller
+    closes."""
+    from ..models import SafetensorsFile
+    paths = weights if isinstance(weights, list) else [weights]
+    return SafetensorsFile(paths[0])
 
 
 def _tokenizer_for(element) -> BPETokenizer | None:
@@ -133,12 +142,80 @@ class LMGenerate(ComputeElement):
 
     Owns its KV cache; generation runs as one jit (prefill + fori_loop
     decode), so the pipeline mailbox only sees whole completions.
+
+    Chat semantics (reference elements_llm.py:137-210): a "system_prompt"
+    parameter and optional "chat_template" ({system}/{context}/{user}
+    fields) format text prompts; with "detections_subscribe" the element
+    watches the "{namespace}/detections" side-channel (or an explicit
+    "detections_topic") and injects objects seen within
+    "detections_window" seconds (default 1.0, the reference's freshness
+    rule, elements_llm.py:196-210) into {context}.
     """
+
+    def __init__(self, process, pipeline, definition):
+        super().__init__(process, pipeline, definition)
+        # subscribe at CONSTRUCTION (not lazy setup): detections published
+        # before the first frame must still be visible to that frame's
+        # prompt, like the reference's init-time subscription
+        # (elements_llm.py:196-210)
+        import time as time_module
+        self._detections = None  # (names, seen_at)
+        from ..utils import parse, truthy
+        topic = self.get_parameter("detections_topic")
+        if topic or truthy(self.get_parameter("detections_subscribe",
+                                              False)):
+            topic = str(topic or f"{self.process.namespace}/detections")
+
+            def handler(_topic, payload):
+                try:
+                    command, parameters = parse(payload)
+                except ValueError:
+                    return
+                if command != "detections":
+                    return
+                names = (parameters[0] if parameters
+                         and isinstance(parameters[0], list)
+                         else parameters)
+                self._detections = ([str(name) for name in names],
+                                    time_module.time())
+
+            self._detections_handler = (handler, topic)
+            self.process.add_message_handler(handler, topic)
 
     def setup(self):
         self.config = _transformer_config(self)
         self.tokenizer = _tokenizer_for(self)
         return _load_transformer_params(self, self.config)
+
+    def _format_prompt(self, stream, text: str) -> str:
+        """Chat formatting: system prompt + fresh vision context + user
+        turn.  Plain passthrough when neither is configured."""
+        import time as time_module
+        system = self.get_parameter("system_prompt", None, stream)
+        template = self.get_parameter("chat_template", None, stream)
+        context = ""
+        if self._detections is not None:
+            names, seen_at = self._detections
+            window = float(self.get_parameter(
+                "detections_window", 1.0, stream))
+            if names and time_module.time() - seen_at <= window:
+                context = ("Visible objects: "
+                           + ", ".join(names) + ".\n")
+        if not (system or template or context):
+            return text
+        template = template or "{system}\n{context}{user}"
+        # plain substitution, NOT str.format: templates legitimately
+        # contain literal braces (JSON / S-expression reply formats)
+        return (template.replace("{system}", system or "")
+                .replace("{context}", context)
+                .replace("{user}", text))
+
+    def stop(self) -> None:
+        handler = getattr(self, "_detections_handler", None)
+        if handler is not None:
+            self.process.remove_message_handler(*handler)
+            self._detections_handler = None
+        super().stop()
 
     def _sp_cache(self, batch: int, max_len: int):
         """KV cache laid out for sequence-parallel decode: length sharded
@@ -159,12 +236,16 @@ class LMGenerate(ComputeElement):
         import contextlib
         self._ensure_ready()
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
+        formatted = None
         if tokens is None:
             if text is None:
                 raise ValueError("LMGenerate needs tokens or text input")
             prompts = [text] if isinstance(text, str) else list(text)
             if self.tokenizer is None:
                 raise ValueError("text input needs a tokenizer parameter")
+            prompts = [self._format_prompt(stream, prompt)
+                       for prompt in prompts]
+            formatted = prompts
             encoded = [self.tokenizer.encode(p, bos=True) for p in prompts]
             width = max(len(ids) for ids in encoded)
             pad = self.tokenizer.pad_id or 0
@@ -229,6 +310,8 @@ class LMGenerate(ComputeElement):
                                   max_new, cache=cache)
                 out = out[:batch]
         result = {"generated": out}
+        if formatted is not None:
+            result["prompt"] = formatted  # post-template (observability)
         if self.tokenizer is not None:
             result["text"] = [self.tokenizer.decode(np.asarray(row))
                               for row in np.asarray(out)]
@@ -282,8 +365,8 @@ class SpeechToText(ComputeElement):
             # through the whisper name-map (pretrained transcription,
             # reference speech_elements.py:229-262); otherwise the
             # framework's own save_pytree layout
-            from ..models import SafetensorsFile, load_whisper_params
-            probe = SafetensorsFile(weights)
+            from ..models import load_whisper_params
+            probe = _probe_weight_names(weights)
             is_hf = "model.encoder.conv1.weight" in probe
             probe.close()
             if is_hf:
@@ -369,6 +452,37 @@ class TextToSpeech(ComputeElement):
             "audio": waveform, "sample_rate": self.config.sample_rate}
 
 
+class DetectionsPublish(AsyncHostElement):
+    """detections (the Detector contract) -> "(detections (names...))" on
+    the "{namespace}/detections" side-channel, closing the vision->LLM
+    loop (reference: the YOLO element publishes and the LLM element
+    injects, elements_llm.py:196-210).  Class ids map through the
+    "class_names" parameter when given.  Runs as an async host element:
+    the device->host readback of the valid mask happens off the event
+    loop.  Detections pass through unchanged for downstream stages."""
+
+    def process_async(self, stream, detections):
+        from ..utils import generate
+        classes = np.asarray(detections["classes"])
+        valid = np.asarray(detections["valid"])
+        class_names = self.get_parameter("class_names", None, stream)
+        names = []
+        for row_classes, row_valid in zip(classes, valid):
+            for class_id, ok in zip(row_classes, row_valid):
+                if not ok:
+                    continue
+                names.append(str(class_names[int(class_id)])
+                             if class_names
+                             and int(class_id) < len(class_names)
+                             else str(int(class_id)))
+        topic = str(self.get_parameter(
+            "topic", f"{self.process.namespace}/detections", stream))
+        # dedupe, keep first-seen order (reference publishes object names)
+        unique = list(dict.fromkeys(names))
+        self.process.publish(topic, generate("detections", [unique]))
+        return {"detections": detections}
+
+
 class TokensToText(AsyncHostElement):
     """tokens (B, T) -> text list[str] (explicit host boundary: this is
     where token ids leave the device).  With a "tokenizer" parameter
@@ -435,22 +549,33 @@ class Detector(ComputeElement):
         self._yolo = False
         weights = self.get_parameter("weights")
         if weights:
-            from ..models import SafetensorsFile
-            probe = SafetensorsFile(weights)
+            probe = _probe_weight_names(weights)
             self._yolo = ("model.0.conv.weight" in probe
                           or "model.model.0.conv.weight" in probe)
             probe.close()
         if self._yolo:
-            from ..models import YOLOV8N
-            self.config = replace(
-                YOLOV8N,
-                n_classes=int(self.get_parameter("n_classes", 80)),
+            from ..models import YOLO_VARIANTS, infer_yolov8_config
+            overrides = dict(
                 image_size=int(self.get_parameter("image_size", 640)),
                 max_detections=int(
                     self.get_parameter("max_detections", 300)),
                 score_threshold=float(
                     self.get_parameter("score_threshold", 0.25)),
                 dtype=str(self.get_parameter("dtype", "bfloat16")))
+            variant = str(self.get_parameter("yolo_variant", "auto"))
+            if variant == "auto":
+                # architecture read off the checkpoint's own shapes:
+                # any v8 family member (or custom width) loads unnamed
+                self.config = infer_yolov8_config(weights, **overrides)
+            elif variant in YOLO_VARIANTS:
+                self.config = replace(
+                    YOLO_VARIANTS[variant],
+                    n_classes=int(self.get_parameter("n_classes", 80)),
+                    **overrides)
+            else:
+                raise ValueError(
+                    f"unknown yolo_variant {variant!r}; "
+                    f"'auto' or one of {sorted(YOLO_VARIANTS)}")
             return
         preset = self.get_parameter("preset")
         if preset:

@@ -17,5 +17,5 @@ from .detector import (                                       # noqa: F401
     DetectorConfig, init_detector_params, detect, detector_forward,
     decode_boxes, non_max_suppression)
 from .yolo import (                                           # noqa: F401
-    YoloV8Config, YOLOV8N, init_yolo_params, load_yolov8_params,
-    yolo_forward, yolo_detect)
+    YoloV8Config, YOLOV8N, YOLO_VARIANTS, init_yolo_params,
+    infer_yolov8_config, load_yolov8_params, yolo_forward, yolo_detect)

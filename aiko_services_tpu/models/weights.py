@@ -205,10 +205,6 @@ def load_llama_params(paths, config, mesh=None, specs=None):
     Matches the capability of reference elements_llm.py:137-179 (llama3.1)
     with in-framework weights instead of an external runtime.
     """
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec
-
     dtype = np.dtype(config.dtype)
     with open_checkpoint(paths) as (index, raw):
         return _load_llama_indexed(index, raw, config, mesh, specs, dtype)
@@ -346,11 +342,8 @@ def load_whisper_params(paths, config) -> dict:
     read a prefix of the 30 s table); the output head is tied to
     model.decoder.embed_tokens (HF WhisperForConditionalGeneration ties
     proj_out the same way)."""
-    import jax
-    import jax.numpy as jnp
-
     dtype = np.dtype(config.dtype)
-    with open_checkpoint(paths) as (index, raw):
+    with open_checkpoint(paths) as (_index, raw):
         return _load_whisper_indexed(raw, config, dtype)
 
 

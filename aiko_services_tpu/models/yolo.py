@@ -71,6 +71,20 @@ class YoloV8Config:
 
 YOLOV8N = YoloV8Config()
 
+# the published v8 family (ultralytics width/depth multiples applied to
+# the base (64, 128, 256, 512, 1024) channel ladder with per-variant
+# max_channels; repeats = round(base (3, 6, 6, 3) * depth))
+YOLO_VARIANTS = {
+    "n": YoloV8Config(),
+    "s": YoloV8Config(width=(32, 64, 128, 256, 512)),
+    "m": YoloV8Config(width=(48, 96, 192, 384, 576),
+                      repeats=(2, 4, 4, 2), neck_repeats=2),
+    "l": YoloV8Config(width=(64, 128, 256, 512, 512),
+                      repeats=(3, 6, 6, 3), neck_repeats=3),
+    "x": YoloV8Config(width=(80, 160, 320, 640, 640),
+                      repeats=(3, 6, 6, 3), neck_repeats=3),
+}
+
 
 # -- parameter construction --------------------------------------------------
 
@@ -151,6 +165,40 @@ def _fold_bn(weight, gamma, beta, mean, var, dtype):
         "b": bias.astype(dtype, copy=False)}
 
 
+def infer_yolov8_config(paths, **overrides) -> YoloV8Config:
+    """Derive the family layout (width ladder, C2f repeats, n_classes,
+    reg_max) from an ultralytics checkpoint's own tensor shapes -- any
+    v8 variant (or custom width) loads without naming it.  `overrides`
+    set the non-architectural fields (image_size, thresholds, dtype)."""
+    from .weights import open_checkpoint
+    with open_checkpoint(paths) as (index, _raw):
+        prefix = "" if "model.0.conv.weight" in index else "model."
+        if prefix + "model.0.conv.weight" not in index:
+            raise KeyError(
+                "not an ultralytics YOLOv8 checkpoint: missing "
+                "model.0.conv.weight")
+
+        def out_channels(name):
+            return index[prefix + name].shape(prefix + name)[0]
+
+        def repeats_of(module):
+            count = 0
+            while (f"{prefix}model.{module}.m.{count}.cv1.conv.weight"
+                   in index):
+                count += 1
+            return max(count, 1)
+
+        return YoloV8Config(
+            width=tuple(out_channels(f"model.{i}.conv.weight")
+                        for i in (0, 1, 3, 5, 7)),
+            repeats=(repeats_of(2), repeats_of(4), repeats_of(6),
+                     repeats_of(8)),
+            neck_repeats=repeats_of(12),
+            n_classes=out_channels("model.22.cv3.0.2.weight"),
+            reg_max=out_channels("model.22.cv2.0.2.weight") // 4,
+            **overrides)
+
+
 def load_yolov8_params(paths, config: YoloV8Config) -> dict:
     """Ultralytics YOLOv8 naming -> this module's pytree (BN folded).
 
@@ -166,6 +214,18 @@ def load_yolov8_params(paths, config: YoloV8Config) -> dict:
             raise KeyError(
                 "not an ultralytics YOLOv8 checkpoint: missing "
                 "model.0.conv.weight")
+        stem_out = index[prefix + "model.0.conv.weight"].shape(
+            prefix + "model.0.conv.weight")[0]
+        if stem_out != config.width[0]:
+            variants = {cfg.width[0]: name
+                        for name, cfg in YOLO_VARIANTS.items()}
+            hint = variants.get(stem_out)
+            raise ValueError(
+                f"checkpoint stem has {stem_out} channels but the config "
+                f"expects width {config.width}"
+                + (f" -- this looks like yolov8{hint}; set the "
+                   f"yolo_variant parameter (or YOLO_VARIANTS[{hint!r}])"
+                   if hint else ""))
         dtype = np.dtype(config.dtype)
 
         def raw(name):
@@ -259,6 +319,12 @@ def yolo_forward(params: dict, config: YoloV8Config, images):
 
     One transpose to NHWC at entry; every conv runs channels-last on the
     MXU (layers.py conv2d NHWC/HWIO rationale)."""
+    height, width = images.shape[2], images.shape[3]
+    if height % 32 or width % 32:
+        raise ValueError(
+            f"yolov8 needs H and W divisible by 32 (5 stride-2 stages + "
+            f"exact 2x upsampling), got {height}x{width}; resize or pad "
+            f"first (e.g. the ImageResize element)")
     x = images.astype(config.jnp_dtype).transpose(0, 2, 3, 1)
     x = _conv(params["m0"], x, stride=2)                     # P1
     x = _conv(params["m1"], x, stride=2)                     # P2

@@ -131,17 +131,35 @@ def named_sharding(mesh: Mesh, spec) -> NamedSharding:
 def shard_pytree(tree, mesh: Mesh, specs):
     """device_put a pytree with per-leaf PartitionSpecs.
 
-    specs may be a single spec applied to every leaf or a pytree matching
-    `tree`'s structure.
-    """
+    specs may be a single spec applied to every leaf, or a (possibly
+    PARTIAL) pytree: leaves present in `tree` but absent from `specs`
+    replicate.  Partial trees matter for checkpoint ingestion -- an HF
+    whisper pytree carries bias leaves the published asr_param_specs
+    doesn't name, and under global-view SPMD a replicated bias is
+    correct (XLA still partitions the matmuls it feeds)."""
     if isinstance(specs, (PartitionSpec, list, tuple)) or specs is None:
         shardings = jax.tree_util.tree_map(
             lambda _: named_sharding(mesh, specs), tree)
     else:
-        shardings = jax.tree_util.tree_map(
-            lambda spec: named_sharding(mesh, spec), specs,
-            is_leaf=lambda leaf: (leaf is None
-                                  or isinstance(leaf, (PartitionSpec, list))))
+        def build(node, spec_node):
+            if isinstance(node, dict):
+                spec_map = spec_node if isinstance(spec_node, dict) else {}
+                return {key: build(value, spec_map.get(key))
+                        for key, value in node.items()}
+            if isinstance(node, (list, tuple)):
+                spec_items = (spec_node
+                              if isinstance(spec_node, (list, tuple))
+                              and len(spec_node) == len(node)
+                              else [None] * len(node))
+                built = [build(value, spec)
+                         for value, spec in zip(node, spec_items)]
+                return type(node)(built) if isinstance(node, tuple) else (
+                    built)
+            spec = (spec_node if spec_node is None or isinstance(
+                spec_node, (PartitionSpec, list, tuple, str)) else None)
+            return named_sharding(mesh, spec)
+
+        shardings = build(tree, specs)
     return jax.device_put(tree, shardings)
 
 

@@ -440,3 +440,124 @@ def test_lm_generate_sp_text_pad_parity():
         definition, {"text": prompts[0]}, timeout=180)
     np.testing.assert_array_equal(
         np.asarray(single["generated"]), np.asarray(expected)[:1])
+
+
+# -- LLM chat semantics + detections side-channel ----------------------------
+# (reference elements_llm.py:137-210: S-expression-constrained system
+# prompt; {ns}/detections subscription with a 1 s freshness window)
+
+def _chat_lm_pipeline(process, window=30.0):
+    # default window is wide: first-frame setup (tokenizer + params +
+    # compile) can exceed the reference's 1 s freshness rule, which the
+    # dedicated staleness test covers with a warmed model
+    definition = {
+        "name": "chat_lm",
+        "graph": ["(lm)"],
+        "elements": [
+            {"name": "lm", "input": [{"name": "text"}],
+             "output": [{"name": "generated"}, {"name": "text"},
+                        {"name": "prompt"}],
+             "parameters": {
+                 "vocab_size": 300, "d_model": 32, "n_layers": 1,
+                 "n_heads": 2, "n_kv_heads": 2, "d_ff": 64,
+                 "max_seq_len": 256, "dtype": "float32",
+                 "tokenizer": "default", "max_new_tokens": 2,
+                 "detections_subscribe": True,
+                 "detections_window": window,
+                 "system_prompt": "You control a robot. Reply with "
+                                  "(action ...) commands only.",
+             },
+             "deploy": local("LMGenerate")},
+        ],
+    }
+    return create_pipeline(process, definition)
+
+
+def test_lm_prompt_includes_fresh_detections_and_system_prompt():
+    process = Process(transport_kind="loopback")
+    pipeline = _chat_lm_pipeline(process)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s", queue_response=responses)
+
+    # cold prompt: system prompt present, no vision context yet
+    pipeline.create_frame(stream, {"text": "wave hello"})
+    _, _, outputs = responses.get(timeout=60)
+    prompt = outputs["prompt"][0]
+    assert "You control a robot" in prompt
+    assert "Visible objects" not in prompt
+    assert "wave hello" in prompt
+
+    # a detections publish lands on the side-channel -> injected
+    from aiko_services_tpu.transport import get_broker
+    process.publish(f"{process.namespace}/detections",
+                    "(detections (person dog))")
+    get_broker().drain()
+    pipeline.create_frame(stream, {"text": "what do you see?"})
+    _, _, outputs = responses.get(timeout=60)
+    prompt = outputs["prompt"][0]
+    assert "Visible objects: person, dog." in prompt
+    assert "what do you see?" in prompt
+    process.terminate()
+
+
+def test_lm_stale_detections_excluded():
+    import time
+    process = Process(transport_kind="loopback")
+    pipeline = _chat_lm_pipeline(process, window=0.2)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s", queue_response=responses)
+    # prime the model compile FIRST so the staleness clock isn't racing
+    # the (slow) first-frame jit
+    pipeline.create_frame(stream, {"text": "warmup"})
+    responses.get(timeout=60)
+
+    from aiko_services_tpu.transport import get_broker
+    process.publish(f"{process.namespace}/detections",
+                    "(detections (cat))")
+    get_broker().drain()
+    time.sleep(0.4)  # let the 0.2 s freshness window lapse
+    pipeline.create_frame(stream, {"text": "now?"})
+    _, _, outputs = responses.get(timeout=60)
+    assert "Visible objects" not in outputs["prompt"][0]
+    process.terminate()
+
+
+def test_detections_publish_element_closes_the_loop():
+    """DetectionsPublish -> side-channel -> LMGenerate context."""
+    process = Process(transport_kind="loopback")
+    lm_pipeline = _chat_lm_pipeline(process)
+    publish_definition = {
+        "name": "vision_pub",
+        "graph": ["(publish)"],
+        "elements": [
+            {"name": "publish", "input": [{"name": "detections"}],
+             "output": [{"name": "detections"}],
+             "parameters": {"class_names": ["car", "bike", "person"]},
+             "deploy": local("DetectionsPublish")},
+        ],
+    }
+    vision_pipeline = create_pipeline(process, publish_definition)
+    process.run(in_thread=True)
+
+    detections = {
+        "boxes": np.zeros((1, 4, 4), np.float32),
+        "scores": np.array([[0.9, 0.8, 0.0, 0.0]], np.float32),
+        "classes": np.array([[2, 0, 0, 0]], np.int32),
+        "valid": np.array([[True, True, False, False]]),
+    }
+    vision_responses = queue.Queue()
+    vision_stream = vision_pipeline.create_stream(
+        "v", queue_response=vision_responses)
+    vision_pipeline.create_frame(vision_stream, {"detections": detections})
+    vision_responses.get(timeout=30)  # publish completed
+
+    from aiko_services_tpu.transport import get_broker
+    get_broker().drain()
+    responses = queue.Queue()
+    stream = lm_pipeline.create_stream("s", queue_response=responses)
+    lm_pipeline.create_frame(stream, {"text": "report"})
+    _, _, outputs = responses.get(timeout=60)
+    assert "Visible objects: person, car." in outputs["prompt"][0]
+    process.terminate()

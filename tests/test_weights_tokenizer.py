@@ -567,3 +567,17 @@ def test_detector_element_ingests_ultralytics_yolo(tmp_path):
     detections = outputs["detections"]
     assert np.asarray(detections["boxes"]).shape == (1, 8, 4)
     process.terminate()
+
+
+def test_infer_yolov8_config_reads_architecture_from_shapes(tmp_path):
+    from aiko_services_tpu.models import infer_yolov8_config
+    config = _tiny_yolo_config()
+    path = tmp_path / "yolo.safetensors"
+    _write_ultralytics_yolo(path, config)
+    inferred = infer_yolov8_config(path, image_size=64, dtype="float32")
+    assert inferred.width == config.width
+    assert inferred.repeats == config.repeats
+    assert inferred.neck_repeats == config.neck_repeats
+    assert inferred.n_classes == config.n_classes
+    assert inferred.reg_max == config.reg_max
+    assert inferred.image_size == 64
