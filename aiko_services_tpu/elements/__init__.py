@@ -12,7 +12,8 @@ from .ml import (                                             # noqa: F401
     LMForward, LMGenerate, SpeechToText, TextToSpeech, Detector,
     DetectionsPublish, TokensToText, TextToTokens)
 from .vision import FaceDetect, ArucoDetect                   # noqa: F401
-from .robot import RobotActor, RobotControl, parse_actions    # noqa: F401
+from .robot import (                                          # noqa: F401
+    RobotActor, RobotControl, RobotCameraSource, parse_actions)
 from .image_io import (                                       # noqa: F401
     ImageReadFile, ImageSource, ImageResize, ImageOverlay, ImageWriteFile,
     ImageOutput)

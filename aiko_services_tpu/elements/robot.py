@@ -23,7 +23,8 @@ from ..pipeline import PipelineElement, StreamEvent
 from ..runtime.actor import Actor
 from ..utils import get_logger
 
-__all__ = ["RobotActor", "RobotControl", "parse_actions"]
+__all__ = ["RobotActor", "RobotControl", "RobotCameraSource",
+           "parse_actions", "encode_camera_frame", "decode_camera_frame"]
 
 _LOGGER = get_logger("robot")
 
@@ -110,6 +111,117 @@ class RobotActor(Actor):
         self.share[key] = value
         if self.ec_producer is not None:
             self.ec_producer.update(key, value)
+
+    # -- camera over binary topics (reference xgo_robot.py ships camera
+    # frames as zlib'd numpy on binary MQTT topics) --------------------
+
+    def start_camera(self, period=1.0, height=64, width=64) -> None:
+        """Wire-invocable "(start_camera 0.5)": publish camera frames to
+        "{topic_path}/video" every `period` seconds as zlib-compressed
+        .npy payloads (the reference's numpy+zlib binary-topic codec,
+        audio_io.py PE_RemoteSend / xgo_robot.py camera loop).
+        Consumers: RobotCameraSource feeds them into pipelines."""
+        self.stop_camera()
+        period = float(period)
+        shape = (int(height), int(width))
+
+        def tick():
+            self.process.publish(f"{self.topic_path}/video",
+                                 encode_camera_frame(self._capture(shape)))
+            self._update_share("camera_frames",
+                               int(self.share.get("camera_frames", 0)) + 1)
+
+        self._camera_timer = tick
+        self.process.event.add_timer_handler(tick, period, immediate=True)
+        self._update_share("camera", f"on period={period}")
+
+    def stop_camera(self) -> None:
+        timer = getattr(self, "_camera_timer", None)
+        if timer is not None:
+            self.process.event.remove_timer_handler(timer)
+            self._camera_timer = None
+            self._update_share("camera", "off")
+
+    def _capture(self, shape) -> "np.ndarray":
+        """Simulated camera: a deterministic scene keyed by the robot's
+        pose (hardware subclasses override with a real sensor read)."""
+        import numpy as np
+        height, width = shape
+        seed = (int(float(self.share["x"]) * 100)
+                ^ int(float(self.share["heading"]))
+                ^ int(self.share.get("camera_frames", 0)))
+        rng = np.random.default_rng(seed & 0x7FFFFFFF)
+        return rng.random((3, height, width), dtype=np.float32)
+
+    def stop(self) -> None:
+        self.stop_camera()
+        super().stop()
+
+
+def encode_camera_frame(array) -> bytes:
+    """ndarray -> zlib(.npy) bytes (binary-topic payload)."""
+    import io
+    import zlib
+
+    import numpy as np
+    buffer = io.BytesIO()
+    np.save(buffer, np.asarray(array), allow_pickle=False)
+    return zlib.compress(buffer.getvalue(), level=1)
+
+
+def decode_camera_frame(payload) -> "np.ndarray":
+    """Inverse of encode_camera_frame; accepts the broker's latin-1 text
+    round-trip of the binary payload."""
+    import io
+    import zlib
+
+    import numpy as np
+    if isinstance(payload, str):
+        payload = payload.encode("latin-1")
+    return np.load(io.BytesIO(zlib.decompress(payload)),
+                   allow_pickle=False)
+
+
+class RobotCameraSource(PipelineElement):
+    """DataSource-style element subscribing to a robot's binary video
+    topic: each received frame enters the stream as {"image": (3,H,W)}
+    (reference capability: xgo_robot camera frames feeding the
+    YOLO/overlay pipelines).  Parameters: "topic" (explicit) or
+    "robot_name" (resolves "{ns}/.../{name}"-discovered robot's
+    /video via the registrar would need discovery; topic is the
+    hermetic path)."""
+
+    def start_stream(self, stream, stream_id):
+        topic = self.get_parameter("topic", None, stream)
+        if not topic:
+            return StreamEvent.ERROR, {
+                "diagnostic": "RobotCameraSource needs a topic parameter"}
+        pipeline = self.pipeline
+
+        def handler(_topic, payload):
+            try:
+                image = decode_camera_frame(payload)
+            except Exception as error:
+                _LOGGER.warning("%s: undecodable camera frame: %s",
+                                self.name, error)
+                return
+            if stream.stream_id in pipeline.streams:
+                pipeline.create_frame(stream, {"image": image})
+
+        stream.variables[f"{self.definition.name}.handler"] = (
+            handler, str(topic))
+        self.process.add_message_handler(handler, str(topic))
+        return StreamEvent.OKAY, None
+
+    def stop_stream(self, stream, stream_id):
+        entry = stream.variables.pop(
+            f"{self.definition.name}.handler", None)
+        if entry is not None:
+            self.process.remove_message_handler(*entry)
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, **inputs):
+        return StreamEvent.OKAY, inputs
 
 
 _ACTION_PATTERN = re.compile(r"\(\s*action\s+([^()]+?)\s*\)")

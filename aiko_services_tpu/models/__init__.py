@@ -19,3 +19,6 @@ from .detector import (                                       # noqa: F401
 from .yolo import (                                           # noqa: F401
     YoloV8Config, YOLOV8N, YOLO_VARIANTS, init_yolo_params,
     infer_yolov8_config, load_yolov8_params, yolo_forward, yolo_detect)
+from .tts import (                                            # noqa: F401
+    TTSConfig, init_tts_params, synthesize, synthesize_mel,
+    encode_chars, make_tts_train_step)

@@ -29,7 +29,7 @@ from .layers import dense, init_dense
 
 __all__ = [
     "TTSConfig", "init_tts_params", "synthesize_mel", "griffin_lim",
-    "synthesize", "encode_chars",
+    "synthesize", "encode_chars", "make_tts_train_step",
 ]
 
 
@@ -161,6 +161,34 @@ def griffin_lim(magnitude, config: TTSConfig) -> jnp.ndarray:
     angles = jax.lax.fori_loop(0, config.griffin_lim_iters, body, angles)
     return _istft(magnitude * jnp.exp(1j * angles), n_fft, hop, window,
                   length)
+
+
+def make_tts_train_step(config: TTSConfig, optimizer):
+    """Returns train_step(params, opt_state, chars, target_mel) ->
+    (params, opt_state, loss): mel-regression MSE through the synthesis
+    net (same convention as transformer.make_train_step).  The trainable
+    path makes TTS a capability, not a shape: fit character->spectral
+    targets (phoneme templates, or real aligned mel data) and
+    synthesize() renders them through the same Griffin-Lim vocoder
+    (reference parity: the Coqui element produces learned speech,
+    speech_elements.py:109-146)."""
+
+    def loss_fn(params, chars, target_mel):
+        mel = synthesize_mel(params, config, chars)
+        return jnp.mean(
+            (mel.astype(jnp.float32) - target_mel.astype(jnp.float32))
+            ** 2)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, chars, target_mel):
+        loss, grads = jax.value_and_grad(loss_fn)(params, chars,
+                                                  target_mel)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    return train_step
 
 
 @partial(jax.jit, static_argnames=("config",))

@@ -27,7 +27,8 @@ import numpy as np
 from .detector import non_max_suppression
 from .layers import conv2d
 
-__all__ = ["YoloV8Config", "YOLOV8N", "init_yolo_params",
+__all__ = ["YoloV8Config", "YOLOV8N", "YOLO_VARIANTS",
+           "init_yolo_params", "infer_yolov8_config",
            "load_yolov8_params", "yolo_forward", "yolo_detect"]
 
 _BN_EPS = 1e-3  # ultralytics Conv uses BatchNorm2d(eps=0.001)

@@ -141,23 +141,43 @@ def shard_pytree(tree, mesh: Mesh, specs):
         shardings = jax.tree_util.tree_map(
             lambda _: named_sharding(mesh, specs), tree)
     else:
+        def spec_leaf(entry):
+            """Is `entry` one whole-array spec (vs a structural subtree)?
+            Axis lists like ["data", None] or [("data", "fsdp"), None]
+            count -- they BROADCAST over a subtree (the old device_put
+            prefix-tree semantics); per-item spec lists must therefore
+            use PartitionSpec objects to stay unambiguous."""
+            if entry is None or isinstance(entry, (PartitionSpec, str)):
+                return True
+            if isinstance(entry, (list, tuple)):
+                return all(
+                    axis is None or isinstance(axis, str)
+                    or (isinstance(axis, (list, tuple))
+                        and all(isinstance(name, str) for name in axis))
+                    for axis in entry)
+            return False
+
         def build(node, spec_node):
             if isinstance(node, dict):
-                spec_map = spec_node if isinstance(spec_node, dict) else {}
-                return {key: build(value, spec_map.get(key))
+                if isinstance(spec_node, dict):
+                    return {key: build(value, spec_node.get(key))
+                            for key, value in node.items()}
+                broadcast = spec_node if spec_leaf(spec_node) else None
+                return {key: build(value, broadcast)
                         for key, value in node.items()}
             if isinstance(node, (list, tuple)):
-                spec_items = (spec_node
-                              if isinstance(spec_node, (list, tuple))
-                              and len(spec_node) == len(node)
-                              else [None] * len(node))
-                built = [build(value, spec)
-                         for value, spec in zip(node, spec_items)]
+                if (isinstance(spec_node, (list, tuple))
+                        and not spec_leaf(spec_node)
+                        and len(spec_node) == len(node)):
+                    built = [build(value, spec)
+                             for value, spec in zip(node, spec_node)]
+                else:
+                    broadcast = spec_node if spec_leaf(spec_node) else None
+                    built = [build(value, broadcast) for value in node]
                 return type(node)(built) if isinstance(node, tuple) else (
                     built)
-            spec = (spec_node if spec_node is None or isinstance(
-                spec_node, (PartitionSpec, list, tuple, str)) else None)
-            return named_sharding(mesh, spec)
+            return named_sharding(
+                mesh, spec_node if spec_leaf(spec_node) else None)
 
         shardings = build(tree, specs)
     return jax.device_put(tree, shardings)

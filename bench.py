@@ -311,7 +311,7 @@ def bench_llm(peak):
 # -- config 4b: mesh-sharded decode (BASELINE config 4's sharded shape) -----
 
 _SHARDED_SCRIPT = r"""
-import json, re, time
+import json, os, re, time
 from dataclasses import replace
 from functools import partial
 
@@ -330,6 +330,9 @@ from aiko_services_tpu.parallel.mesh import create_mesh
 # SHARDING overhead/collective structure, not chip FLOPs
 config = replace(LLAMA32_1B, vocab_size=32768, d_model=512, d_ff=2048,
                  dtype="bfloat16")
+if os.environ.get("AIKO_BENCH_SMOKE", "") not in ("", "0"):
+    config = replace(config, vocab_size=4096, d_model=128, d_ff=512,
+                     n_layers=4)
 mesh = create_mesh({"data": 2, "fsdp": 1, "seq": 1, "model": 4})
 params = shard_pytree(init_params(config, jax.random.PRNGKey(0)), mesh,
                       filter_specs(param_specs(config), mesh))
@@ -361,6 +364,7 @@ print(json.dumps({
     "tokens_per_sec": round(max_new * batch / elapsed, 1),
     "collectives_per_decode_step": len(collectives),
     "collective_kinds": sorted(set(collectives)),
+    "n_layers": config.n_layers,
 }))
 """
 
@@ -394,8 +398,10 @@ def bench_llm_sharded():
                 + (f": {tail[0]}" if tail else "")}
     result = json.loads(probe.stdout.strip().splitlines()[-1])
     result["mesh"] = "virtual 8-device CPU (data=2, model=4)"
-    result["model"] = ("llama32_1b architecture at reduced width "
-                       "(16 layers, 32/8 GQA heads, tied embeddings)")
+    result["model"] = (
+        f"llama32_1b architecture at reduced width "
+        f"({result.pop('n_layers')} layers, 32/8 GQA heads, "
+        f"tied embeddings)")
     return result
 
 
@@ -419,7 +425,7 @@ def bench_multimodal(peak):
     warmup, measure = (2, 8) if SMOKE else (10, 120)
     # 5 s chunks = the reference speech cadence (audio_io.py:455-460)
     audio_seconds = 1.0 if SMOKE else 5.0
-    batch = 1 if SMOKE else 2  # rows per frame (data_batch_size)
+    batch = 1 if SMOKE else 4  # rows per frame (data_batch_size)
     micro = 1 if SMOKE else 4  # frames coalesced per jit call
     max_tokens = 16
     if SMOKE:
@@ -539,8 +545,7 @@ def main() -> None:
     import jax
 
     peak = _peak_flops_per_chip()
-    default_configs = ("text,asr,detector,llm,pipeline" if SMOKE
-                       else "text,asr,detector,llm,llm_sharded,pipeline")
+    default_configs = "text,asr,detector,llm,llm_sharded,pipeline"
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}

@@ -285,3 +285,57 @@ class TestRingAttentionScale:
                                    causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-3, rtol=2e-3)
+
+
+class TestShardPytreeSemantics:
+    """shard_pytree spec-tree semantics: prefix broadcast (the old
+    device_put behavior), partial trees (missing leaves replicate), and
+    per-item structural lists."""
+
+    def _mesh(self):
+        from aiko_services_tpu.parallel.mesh import create_mesh
+        return create_mesh({"data": 2, "model": 4})
+
+    def test_axis_list_broadcasts_over_collection(self):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from aiko_services_tpu.parallel import shard_pytree
+        mesh = self._mesh()
+        tree = {"a": [jnp.zeros((4, 8)), jnp.zeros((4, 8))]}
+        out = shard_pytree(tree, mesh, {"a": ["data", None]})
+        for leaf in out["a"]:
+            assert leaf.sharding.spec == P("data", None), (
+                leaf.sharding.spec)
+
+    def test_partial_tree_missing_leaves_replicate(self):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from aiko_services_tpu.parallel import shard_pytree
+        mesh = self._mesh()
+        tree = {"w": jnp.zeros((4, 8)), "b": jnp.zeros((8,))}
+        out = shard_pytree(tree, mesh, {"w": P(None, "model")})
+        assert out["w"].sharding.spec == P(None, "model")
+        assert out["b"].sharding.is_fully_replicated
+
+    def test_per_item_structural_list(self):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from aiko_services_tpu.parallel import shard_pytree
+        mesh = self._mesh()
+        tree = {"stages": [{"w": jnp.zeros((4, 8))},
+                           {"w": jnp.zeros((8, 4))}]}
+        out = shard_pytree(tree, mesh, {"stages": [
+            {"w": P("data", None)}, {"w": P(None, "data")}]})
+        assert out["stages"][0]["w"].sharding.spec == P("data", None)
+        assert out["stages"][1]["w"].sharding.spec == P(None, "data")
+
+    def test_spec_broadcast_through_subtree(self):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from aiko_services_tpu.parallel import shard_pytree
+        mesh = self._mesh()
+        tree = {"block": {"w1": jnp.zeros((4, 8)),
+                          "w2": jnp.zeros((4, 8))}}
+        out = shard_pytree(tree, mesh, {"block": P("data", None)})
+        assert out["block"]["w1"].sharding.spec == P("data", None)
+        assert out["block"]["w2"].sharding.spec == P("data", None)

@@ -330,3 +330,114 @@ class TestReviewHardening:
             params.get(name, default))
         _, outputs = ArucoDetect.process_frame(element, None, canvas)
         assert outputs["markers"]["ids"] == [11]
+
+
+# -- trainable TTS: learned spectra distinguish phonemes ---------------------
+# (VERDICT r2 next-item 6: "a test that synthesized audio of 'aaaa'
+# differs structurally from 'ssss' beyond random-weight noise")
+
+def _spectral_centroid(waveform, sample_rate=16000):
+    import numpy as np
+    spectrum = np.abs(np.fft.rfft(np.asarray(waveform, np.float64)))
+    freqs = np.fft.rfftfreq(len(waveform), 1.0 / sample_rate)
+    power = spectrum ** 2
+    return float((freqs * power).sum() / max(power.sum(), 1e-12))
+
+
+def test_tts_training_learns_phoneme_spectra():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from aiko_services_tpu.models import (
+        TTSConfig, encode_chars, init_tts_params, make_tts_train_step,
+        synthesize, synthesize_mel)
+
+    config = TTSConfig(d_model=64, n_conv_layers=2, frames_per_char=4,
+                       griffin_lim_iters=8)
+    params = init_tts_params(config, jax.random.PRNGKey(0))
+
+    # phoneme templates: 'a' = vowel energy in LOW mel bands,
+    # 's' = sibilant energy in HIGH mel bands (log-mel space)
+    chars = np.concatenate([encode_chars("aaaaaaaa"),
+                            encode_chars("ssssssss")])
+    frames = chars.shape[1] * config.frames_per_char
+    target = np.full((2, config.n_mels, frames), -6.0, np.float32)
+    target[0, 4:16] = 1.5    # 'a' rows
+    target[1, 60:76] = 1.5   # 's' rows
+    target = jnp.asarray(target)
+    chars = jnp.asarray(chars)
+
+    untrained_a = synthesize(params, config, chars[:1])[0]
+    untrained_s = synthesize(params, config, chars[1:])[0]
+    untrained_gap = abs(_spectral_centroid(untrained_s)
+                        - _spectral_centroid(untrained_a))
+
+    optimizer = optax.adam(3e-3)
+    train_step = make_tts_train_step(config, optimizer)
+    opt_state = optimizer.init(params)
+    first_loss = None
+    for _ in range(300):
+        params, opt_state, loss = train_step(params, opt_state, chars,
+                                             target)
+        if first_loss is None:
+            first_loss = float(loss)
+    assert float(loss) < first_loss * 0.1, (first_loss, float(loss))
+
+    trained_a = synthesize(params, config, chars[:1])[0]
+    trained_s = synthesize(params, config, chars[1:])[0]
+    centroid_a = _spectral_centroid(trained_a)
+    centroid_s = _spectral_centroid(trained_s)
+    # sibilant must sit far above the vowel -- and far beyond whatever
+    # accidental gap random weights produced
+    assert centroid_s > centroid_a * 1.5, (centroid_a, centroid_s)
+    assert centroid_s - centroid_a > 4 * untrained_gap, (
+        untrained_gap, centroid_a, centroid_s)
+
+
+# -- robot camera over binary topics -----------------------------------------
+# (reference xgo_robot.py ships zlib'd numpy camera frames over binary
+# MQTT topics into the vision pipelines)
+
+def test_robot_camera_frames_flow_into_pipeline():
+    import queue
+    import numpy as np
+    from aiko_services_tpu.elements.robot import (
+        decode_camera_frame, encode_camera_frame)
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process
+
+    # codec round-trips through the broker's latin-1 text path
+    frame = np.random.default_rng(0).random((3, 8, 8)).astype(np.float32)
+    wire = encode_camera_frame(frame).decode("latin-1")
+    np.testing.assert_array_equal(decode_camera_frame(wire), frame)
+
+    process = Process(transport_kind="loopback")
+    from aiko_services_tpu.elements import RobotActor
+    robot = RobotActor(process, name="dog")
+    definition = {
+        "name": "robot_vision",
+        "graph": ["(camera (stats))"],
+        "elements": [
+            {"name": "camera", "output": [{"name": "image"}],
+             "parameters": {"topic": f"{robot.topic_path}/video"},
+             "deploy": {"local": {"module": "aiko_services_tpu.elements",
+                                  "class_name": "RobotCameraSource"}}},
+            {"name": "stats", "input": [{"name": "image"}],
+             "output": [{"name": "image"}],
+             "deploy": {"local": {"module": "aiko_services_tpu.elements",
+                                  "class_name": "PE_Inspect"}}},
+        ],
+    }
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s", queue_response=responses)
+    robot.start_camera(period=0.05, height=16, width=16)
+    seen = [responses.get(timeout=20) for _ in range(3)]
+    robot.stop_camera()
+    for _, _, outputs in seen:
+        assert np.asarray(outputs["image"]).shape == (3, 16, 16)
+    assert int(robot.share["camera_frames"]) >= 3
+    assert robot.share["camera"] == "off"
+    process.terminate()
