@@ -78,9 +78,21 @@ class DataSource(PipelineElement):
         stream.variables[key] = index + 1
         return index
 
+    # path-like sources expand "file://" prefixes and glob patterns;
+    # literal-content sources (TextSource: prompts may contain ? or *)
+    # override with False
+    expand_sources = True
+
     def start_stream(self, stream, stream_id):
         data_sources = self.get_parameter("data_sources", None, stream)
-        items = expand_data_sources(data_sources)
+        if self.expand_sources:
+            items = expand_data_sources(data_sources)
+        elif data_sources is None:
+            items = []
+        elif isinstance(data_sources, (str, Path)):
+            items = [data_sources]
+        else:
+            items = list(data_sources)
         if not items:
             return StreamEvent.ERROR, {"diagnostic": "no data_sources"}
         rate = self.get_parameter("rate", None, stream)

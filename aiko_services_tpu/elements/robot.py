@@ -198,7 +198,14 @@ class RobotCameraSource(PipelineElement):
                 "diagnostic": "RobotCameraSource needs a topic parameter"}
         pipeline = self.pipeline
 
+        window = int(self.get_parameter("frame_window", 16, stream))
+
         def handler(_topic, payload):
+            if stream.pending >= window:
+                # backpressure like every DataSource: a camera outrunning
+                # the pipeline (e.g. during a downstream jit compile)
+                # drops frames instead of queuing minutes-stale ones
+                return
             try:
                 image = decode_camera_frame(payload)
             except Exception as error:

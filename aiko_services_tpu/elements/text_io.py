@@ -23,7 +23,10 @@ class TextReadFile(DataSource):
 
 
 class TextSource(DataSource):
-    """In-memory text source: data_sources is a list of strings."""
+    """In-memory text source: data_sources is a list of LITERAL strings
+    (no path/glob expansion -- prompts legitimately contain ? and *)."""
+
+    expand_sources = False
 
     def read_item(self, stream, item) -> dict:
         return {"text": str(item)}

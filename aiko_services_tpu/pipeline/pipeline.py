@@ -178,6 +178,12 @@ class Pipeline(Actor):
             _LOGGER.warning("%s: bad create_stream arguments: %s",
                             self.name, error)
             return None
+        if graph_path and str(graph_path) not in self.graph:
+            # validate BEFORE registering: a bad head must not leave a
+            # half-created stream holding a lease
+            _LOGGER.warning("%s: unknown graph_path %r for stream %s",
+                            self.name, graph_path, stream_id)
+            return None
         stream = Stream(
             stream_id=stream_id, parameters=parameters or {},
             topic_response=topic_response or None,
@@ -192,7 +198,7 @@ class Pipeline(Actor):
         # Remote streams FIRST: a local DataSource may start generating
         # frames the moment start_stream returns, and those frames must not
         # reach a remote pipeline before its create_stream does.
-        for node_name in self.graph.get_path():
+        for node_name in self.graph.get_path(stream.graph_path):
             element = self.elements[node_name]
             if isinstance(element, RemoteElement):
                 element.call("create_stream", [
@@ -201,7 +207,7 @@ class Pipeline(Actor):
                     grace_time,
                     self.topic_in,
                 ])
-        for node_name in self.graph.get_path():
+        for node_name in self.graph.get_path(stream.graph_path):
             element = self.elements[node_name]
             if not isinstance(element, RemoteElement):
                 stream_event, diagnostic = self._safe_call(
@@ -240,7 +246,7 @@ class Pipeline(Actor):
         lease = self._stream_leases.pop(stream_id, None)
         if lease is not None:
             lease.terminate()
-        for node_name in self.graph.get_path():
+        for node_name in self.graph.get_path(stream.graph_path):
             element = self.elements[node_name]
             if isinstance(element, RemoteElement):
                 element.call("destroy_stream", [stream_id])
@@ -367,8 +373,10 @@ class Pipeline(Actor):
 
     def _run_frame(self, stream: Stream, frame: Frame,
                    resume_after: str | None) -> None:
-        nodes = (self.graph.get_path() if resume_after is None
-                 else self.graph.iterate_after(resume_after))
+        nodes = (self.graph.get_path(stream.graph_path)
+                 if resume_after is None
+                 else self.graph.iterate_after(resume_after,
+                                               stream.graph_path))
         time_start = time.perf_counter()
         for node_name in nodes:
             if stream.state != StreamState.RUN:
@@ -730,7 +738,8 @@ class Pipeline(Actor):
 
         cursors = {
             stream_id: {"frame_id": stream.frame_id,
-                        "parameters": json_safe(stream.parameters)}
+                        "parameters": json_safe(stream.parameters),
+                        "graph_path": stream.graph_path}
             for stream_id, stream in self.streams.items()}
         return checkpointer.save(
             step, states,
@@ -753,6 +762,7 @@ class Pipeline(Actor):
             if stream is None:
                 self.create_stream(stream_id,
                                    parameters=cursor.get("parameters"),
+                                   graph_path=cursor.get("graph_path"),
                                    first_frame_id=frame_id)
             elif stream.frame_id < frame_id:
                 stream.frame_id = frame_id

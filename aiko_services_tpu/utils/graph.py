@@ -47,6 +47,7 @@ class Graph:
         self._nodes: dict[str, Node] = {}
         self._head_nodes: list[str] = list(head_nodes or [])
         self._order_cache: list[str] | None = None
+        self._path_cache: dict[str, list] = {}
 
     # -- construction -----------------------------------------------------
 
@@ -90,6 +91,7 @@ class Graph:
             else:
                 raise GraphError(f"Bad graph node: {child!r}")
         self._order_cache = None
+        self._path_cache.clear()
         return head_name
 
     def _intern(self, token: str, callback) -> str:
@@ -108,6 +110,7 @@ class Graph:
         if head and node.name not in self._head_nodes:
             self._head_nodes.append(node.name)
         self._order_cache = None
+        self._path_cache.clear()
 
     # -- queries ----------------------------------------------------------
 
@@ -179,19 +182,41 @@ class Graph:
         self._order_cache = order
         return list(order)
 
-    def get_path(self) -> list[str]:
-        """Execution order of all nodes (reference graph.py:61-78)."""
-        return self.topological_order()
-
-    def iterate_after(self, name: str) -> list[str]:
-        """Nodes strictly after `name` in execution order -- used to resume a
-        frame when a remote element replies (reference graph.py:96-103)."""
+    def get_path(self, head: str | None = None) -> list[str]:
+        """Execution order (reference graph.py:61-78).  With `head`, only
+        the nodes reachable from that head -- per-stream sub-paths in
+        multi-root graphs (reference pipeline_paths.json capability:
+        Stream.graph_path selects which root a stream executes)."""
         order = self.topological_order()
+        if head is None:
+            return order
+        cached = self._path_cache.get(head)
+        if cached is not None:  # hot path: get_path runs once per frame
+            return list(cached)
+        if head not in self._nodes:
+            raise GraphError(f"Unknown graph path head: {head}")
+        reachable: set = set()
+        stack = [head]
+        while stack:
+            name = stack.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            stack.extend(self._nodes[name].successors)
+        path = [name for name in order if name in reachable]
+        self._path_cache[head] = path
+        return list(path)
+
+    def iterate_after(self, name: str, head: str | None = None) -> list:
+        """Nodes strictly after `name` in execution order (restricted to
+        `head`'s sub-path when given) -- used to resume a frame when a
+        remote element replies (reference graph.py:96-103)."""
+        path = self.get_path(head)
         try:
-            index = order.index(name)
+            index = path.index(name)
         except ValueError:
             raise GraphError(f"Unknown node: {name}") from None
-        return order[index + 1:]
+        return path[index + 1:]
 
     def __repr__(self):
         return f"Graph({self.topological_order()})"

@@ -118,3 +118,97 @@ def test_pipeline_longcontext_ragged_length_buckets():
     assert logits.shape == (1, 50, 128)  # un-padded back to 50
     assert np.isfinite(logits).all()
     process.terminate()
+
+
+def test_pipeline_robot_loop_example_end_to_end():
+    """The full reference xgo story, hermetic: robot camera (binary
+    video topic) -> detector -> detections side-channel -> chat LM
+    (vision context injected into the system-prompted request) ->
+    RobotControl driving the robot from (action ...) text."""
+    import json
+    import queue
+    from pathlib import Path
+
+    import numpy as np
+
+    from aiko_services_tpu.elements import RobotActor
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process, Registrar
+    from aiko_services_tpu.transport import get_broker
+
+    definition = json.loads(
+        (Path(__file__).parent.parent
+         / "examples/pipeline_robot_loop.json").read_text())
+    process = Process(transport_kind="loopback")
+    Registrar(process, search_timeout=0.05)
+    robot = RobotActor(process, name="dog")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+
+    import threading
+    published = threading.Event()
+    process.add_message_handler(
+        lambda _topic, _payload: published.set(),
+        f"{process.namespace}/detections")
+
+    responses = queue.Queue()
+    # multi-root graph: each stream executes ONE root's sub-path
+    # (Stream.graph_path, the reference pipeline_paths capability)
+    pipeline.create_stream(
+        "vision", queue_response=queue.Queue(), graph_path="camera",
+        grace_time=300,
+        parameters={"camera.topic": f"{robot.topic_path}/video"})
+    robot.start_camera(period=0.1, height=64, width=64)
+    # wait for the vision leg (camera -> detector -> publish) to emit on
+    # the side-channel BEFORE asking -- detector compile dominates
+    assert published.wait(timeout=240), (
+        "vision leg never published detections")
+
+    pipeline.create_stream(
+        "chat", queue_response=responses, graph_path="ask",
+        parameters={
+            "control.robot_topic": robot.topic_path,
+            "detections_window": 300.0,  # compile tolerance
+        })
+    saw_prompt_with_context = False
+    saw_robot_action = False
+    for _ in range(8):
+        try:
+            _, frame, outputs = responses.get(timeout=60)
+        except queue.Empty:
+            break
+        if "prompt" in outputs:
+            prompt = outputs["prompt"][0]
+            assert "You control a robot dog" in prompt
+            if "Visible objects:" in prompt:
+                saw_prompt_with_context = True
+        if saw_prompt_with_context:
+            break
+    robot.stop_camera()
+    assert saw_prompt_with_context, (
+        "LM prompt never received vision context")
+
+    # the control leg: literal action text drives the discovered robot
+    # (the LM is random-weight here; the reference constrains it to this
+    # grammar via the same system prompt)
+    before = float(robot.share["odometer"])
+    # graph_path may name ANY node: a "drive" stream runs just the
+    # control element, feeding it literal action text
+    pipeline.create_stream(
+        "drive", queue_response=queue.Queue(), graph_path="control",
+        parameters={"control.robot_topic": robot.topic_path})
+    pipeline.create_frame(
+        pipeline.streams["drive"],
+        {"text": ["(action move 0.5) (action speak hello)"]})
+    # the injected frame enters at the graph heads; drain until the
+    # robot's odometer moves
+    get_broker().drain()
+    import time
+    for _ in range(100):
+        if float(robot.share["odometer"]) > before:
+            saw_robot_action = True
+            break
+        time.sleep(0.1)
+    assert saw_robot_action, "robot never acted on (action move 0.5)"
+    assert robot.share["utterances"] >= 1
+    process.terminate()
