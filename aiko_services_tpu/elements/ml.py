@@ -115,6 +115,22 @@ def _tokenizer_for(element) -> BPETokenizer | None:
     return BPETokenizer.from_file(source)
 
 
+def _default_state_spec(element, spec_factory) -> None:
+    """Meshed model elements default their state spec to the family's
+    megatron spec tree (filtered to the element mesh) instead of full
+    replication -- an 8B replicated over v5e-8 would blow per-chip HBM;
+    an explicit sharding.state in the definition still wins."""
+    if element.mesh is not None and element._state_spec is None:
+        from ..parallel import filter_specs
+        element._state_spec = filter_specs(spec_factory(), element.mesh)
+
+
+def _default_lm_state_spec(element, config) -> None:
+    from ..models import param_specs
+    _default_state_spec(
+        element, lambda: param_specs(config, lm_head=True))
+
+
 class LMForward(ComputeElement):
     """tokens (B, L) -> logits (B, L, V) + per-sequence mean NLL.
 
@@ -124,6 +140,7 @@ class LMForward(ComputeElement):
 
     def setup(self):
         self.config = _transformer_config(self)
+        _default_lm_state_spec(self, self.config)
         params = _load_transformer_params(self, self.config)
         _LOGGER.info("%s: transformer %.1fM params",
                      self.definition.name, count_params(params) / 1e6)
@@ -184,6 +201,7 @@ class LMGenerate(ComputeElement):
 
     def setup(self):
         self.config = _transformer_config(self)
+        _default_lm_state_spec(self, self.config)
         self.tokenizer = _tokenizer_for(self)
         return _load_transformer_params(self, self.config)
 
@@ -359,6 +377,12 @@ class SpeechToText(ComputeElement):
                 max_frames=int(self.get_parameter("max_frames", 1500)),
                 dtype=str(self.get_parameter("dtype", "bfloat16")),
             )
+        # meshed ASR defaults to the megatron TP spec tree (HF bias
+        # leaves absent from the spec replicate -- correct under
+        # global-view SPMD)
+        from ..models import asr_param_specs
+        _default_state_spec(
+            self, lambda: asr_param_specs(self.config))
         weights = self.get_parameter("weights")
         if weights:
             # probe the container: HF openai/whisper-* naming loads

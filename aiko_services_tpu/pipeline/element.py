@@ -199,12 +199,16 @@ class AsyncHostElement(PipelineElement):
         stream_id = stream.stream_id
         pipeline = self.pipeline
 
+        node = self.definition.name  # responses name their node so
+        # sibling branches can be in flight concurrently
+
         def work():
             start = time.perf_counter()
             try:
                 outputs = self.process_async(stream, **inputs)
                 pipeline.post_message("process_frame_response", [
                     {"stream_id": stream_id, "frame_id": frame_id,
+                     "node": node,
                      "time": time.perf_counter() - start},
                     outputs or {}])
             except Exception as error:
@@ -212,7 +216,7 @@ class AsyncHostElement(PipelineElement):
                               self.definition.name, error)
                 pipeline.post_message("process_frame_response", [
                     {"stream_id": stream_id, "frame_id": frame_id,
-                     "event": "error"}, {}])
+                     "node": node, "event": "error"}, {}])
 
         self._get_executor().submit(work)
         return StreamEvent.PENDING, None

@@ -332,9 +332,20 @@ class Pipeline(Actor):
             return
         frame_id = int(stream_dict.get("frame_id", 0))
         frame = stream.frames.get(frame_id)
-        if frame is None or frame.paused_pe_name is None:
+        if frame is None or (frame.paused_pe_name is None
+                             and not frame.pending_nodes):
             _LOGGER.debug("%s: response for unknown frame %s/%s",
                           self.name, stream_id, frame_id)
+            return
+        # concurrent branches: responses name their node; remote hops
+        # (exclusive parks) fall back to paused_pe_name
+        resumed_node = (stream_dict.get("node") or frame.paused_pe_name)
+        if resumed_node is None or (
+                resumed_node not in frame.pending_nodes
+                and resumed_node != frame.paused_pe_name):
+            _LOGGER.debug("%s: response for non-pending node %r on "
+                          "frame %s/%s", self.name, resumed_node,
+                          stream_id, frame_id)
             return
         if isinstance(frame_data, str):
             try:
@@ -346,16 +357,13 @@ class Pipeline(Actor):
                 _LOGGER.warning(
                     "%s: frame response payload lost (%s); releasing "
                     "frame %s/%s", self.name, error, stream_id, frame_id)
-                frame.paused_pe_name = None
                 self._finish_frame(stream, frame, dropped=True, error=True)
                 return
         remote_event = stream_dict.get("event")
         if remote_event:  # remote dropped/errored the frame: release it
-            frame.paused_pe_name = None
             self._finish_frame(stream, frame, dropped=True,
                                error=(remote_event == "error"))
             return
-        resumed_node = frame.paused_pe_name
         outputs = frame_data or {}
         element = self.elements.get(resumed_node)
         if element is not None and not isinstance(element, RemoteElement):
@@ -368,31 +376,50 @@ class Pipeline(Actor):
                 frame.metrics.get(f"time_{resumed_node}", 0.0)
                 + float(elapsed))
         frame.swag.update(outputs)
-        frame.paused_pe_name = None
+        frame.pending_nodes.discard(resumed_node)
+        if frame.paused_pe_name == resumed_node:
+            frame.paused_pe_name = None
         self._run_frame(stream, frame, resume_after=resumed_node)
 
     def _run_frame(self, stream: Stream, frame: Frame,
                    resume_after: str | None) -> None:
-        nodes = (self.graph.get_path(stream.graph_path)
-                 if resume_after is None
-                 else self.graph.iterate_after(resume_after,
-                                               stream.graph_path))
+        """One execution pass over the frame's graph path.
+
+        Dependency-aware branch concurrency (beyond the reference's
+        strictly sequential loop, pipeline.py:1037-1092): a node whose
+        work leaves the event loop (async host element, micro-batch
+        park) only defers its own DESCENDANTS -- siblings with satisfied
+        inputs keep dispatching, so a slow host readback never idles the
+        device behind it.  Each resume event re-enters this pass;
+        frame.executed / frame.pending_nodes make passes idempotent.
+        Remote hops still park the whole frame (their reply cannot name
+        a node)."""
+        if resume_after is not None:
+            frame.executed.add(resume_after)
         time_start = time.perf_counter()
-        for node_name in nodes:
+        for node_name in self.graph.get_path(stream.graph_path):
             if stream.state != StreamState.RUN:
                 break
+            if (node_name in frame.executed
+                    or node_name in frame.pending_nodes):
+                continue
             stream.current_frame_id = frame.frame_id
             element = self.elements[node_name]
             definition = element.definition
             try:
                 inputs = self._map_in(frame.swag, definition)
             except KeyError as error:
+                if frame.pending_nodes:
+                    # input produced by an in-flight branch: this node
+                    # retries on that branch's resume pass
+                    continue
                 _LOGGER.error("%s: %s missing input %s",
                               self.name, node_name, error)
                 self._finish_frame(stream, frame, error=True)
                 return
             if isinstance(element, RemoteElement):
                 frame.paused_pe_name = node_name
+                frame.pending_nodes.add(node_name)
                 element.call("process_frame", [
                     {"stream_id": stream.stream_id,
                      "frame_id": frame.frame_id,
@@ -402,7 +429,9 @@ class Pipeline(Actor):
                 return  # frame stays parked in stream.frames
             if self._try_park_micro(stream, frame, node_name, element,
                                     inputs):
-                return  # frame parked awaiting a coalesced flush
+                if stream.frames.get(frame.frame_id) is not frame:
+                    return  # an inline flush already finished the frame
+                continue  # parked branch; siblings keep dispatching
             element_start = time.perf_counter()
             stream_event, outputs = self._safe_call(
                 element.process_frame, stream, **inputs)
@@ -410,14 +439,20 @@ class Pipeline(Actor):
                 frame.metrics.get(f"time_{node_name}", 0.0)
                 + time.perf_counter() - element_start)
             if stream_event == StreamEvent.OKAY:
+                frame.executed.add(node_name)
                 frame.swag.update(self._map_out(outputs or {}, definition))
             elif stream_event == StreamEvent.PENDING:
                 # element continues off the event loop (AsyncHostElement
-                # worker thread); frame parks exactly like a remote hop
-                # and resumes through process_frame_response -- the event
-                # loop is free for other frames meanwhile
-                frame.paused_pe_name = node_name
-                return
+                # worker thread); the branch parks and resumes through
+                # process_frame_response while siblings continue below.
+                # The single fallback-identity slot belongs to remote
+                # hops (their replies cannot name a node) -- only claim
+                # it when free; AsyncHostElement responses always name
+                # their node, and custom PENDING elements must too when
+                # combined with remote hops
+                if frame.paused_pe_name is None:
+                    frame.paused_pe_name = node_name
+                frame.pending_nodes.add(node_name)
             elif stream_event == StreamEvent.DROP_FRAME:
                 self._finish_frame(stream, frame, dropped=True)
                 return
@@ -438,6 +473,8 @@ class Pipeline(Actor):
         frame.metrics["time_pipeline"] = (
             frame.metrics.get("time_pipeline", 0.0)
             + time.perf_counter() - time_start)
+        if frame.pending_nodes:
+            return  # parked branches resume this pass later
         self._finish_frame(stream, frame)
 
     # -- micro-batching (no reference counterpart: the reference processes
@@ -485,7 +522,7 @@ class Pipeline(Actor):
             return False
         key = (node_name, stream.stream_id)
         pending = self._micro_pending.setdefault(key, [])
-        frame.paused_pe_name = node_name
+        frame.pending_nodes.add(node_name)
         pending.append((frame, inputs, signature))
         if len(pending) >= micro:
             self._flush_micro_batch(node_name, stream.stream_id)
@@ -506,6 +543,10 @@ class Pipeline(Actor):
             return  # stream destroyed while parked: frames died with it
         micro = max(1, int(
             element.get_parameter("micro_batch", 1, stream) or 1))
+        # frames finished elsewhere (drop/error on another branch) are
+        # no longer live: never resume them
+        pending = [entry for entry in pending
+                   if stream.frames.get(entry[0].frame_id) is entry[0]]
         while pending:
             group = [pending.pop(0)]
             signature = group[0][2]
@@ -554,7 +595,11 @@ class Pipeline(Actor):
         if stream_event == StreamEvent.PENDING:
             if len(group) == 1:
                 # element continues off the event loop and resumes the
-                # frame via process_frame_response (frame stays parked)
+                # frame via process_frame_response (frame stays parked
+                # in pending_nodes; the fallback-identity slot is only
+                # claimed when no remote hop holds it)
+                if group[0][0].paused_pe_name is None:
+                    group[0][0].paused_pe_name = node_name
                 return
             stream_event, outputs = StreamEvent.ERROR, {
                 "diagnostic": (
@@ -568,11 +613,13 @@ class Pipeline(Actor):
                 frame_outputs = self._split_micro_outputs(
                     outputs or {}, offset, count, target)
                 offset += count
+                if stream.frames.get(frame.frame_id) is not frame:
+                    continue  # finished on another branch meanwhile
                 frame.metrics[f"time_{node_name}"] = (
                     frame.metrics.get(f"time_{node_name}", 0.0) + share)
                 frame.swag.update(self._map_out(frame_outputs,
                                                 element.definition))
-                frame.paused_pe_name = None
+                frame.pending_nodes.discard(node_name)
                 self._run_frame(stream, frame, resume_after=node_name)
                 if stream.destroying or (
                         stream.stream_id not in self.streams):
@@ -581,7 +628,7 @@ class Pipeline(Actor):
             # non-OKAY applies to the whole coalesced call: release every
             # frame under the same StreamEvent policy as the inline path
             for frame, _, _ in group:
-                frame.paused_pe_name = None
+                frame.pending_nodes.discard(node_name)
                 frame.metrics[f"time_{node_name}"] = (
                     frame.metrics.get(f"time_{node_name}", 0.0) + share)
             if stream_event == StreamEvent.DROP_FRAME:
@@ -641,6 +688,16 @@ class Pipeline(Actor):
 
     def _finish_frame(self, stream: Stream, frame: Frame,
                       dropped: bool = False, error: bool = False) -> None:
+        if stream.frames.get(frame.frame_id) is not frame:
+            return  # already finished (reentrant resume/flush paths)
+        # in-flight branch work for this frame must never resume it:
+        # strip it from every micro-batch pending list
+        if frame.pending_nodes:
+            for key, entries in list(self._micro_pending.items()):
+                if key[1] != stream.stream_id:
+                    continue
+                self._micro_pending[key] = [
+                    entry for entry in entries if entry[0] is not frame]
         stream.frames.pop(frame.frame_id, None)
         if stream.pending > 0:
             stream.pending -= 1

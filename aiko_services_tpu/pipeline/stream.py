@@ -49,7 +49,13 @@ class Frame:
     frame_id: int = FIRST_FRAME_ID
     swag: dict = field(default_factory=dict)
     metrics: dict = field(default_factory=dict)
+    # remote hops park the frame EXCLUSIVELY here (the reply cannot name
+    # its node); local async/micro parks use pending_nodes instead so
+    # sibling branches keep executing (fan-out concurrency -- the
+    # reference executes branches sequentially, pipeline.py:1037-1092)
     paused_pe_name: str | None = None
+    executed: set = field(default_factory=set)       # nodes completed
+    pending_nodes: set = field(default_factory=set)  # nodes in flight
 
 
 @dataclass

@@ -425,8 +425,10 @@ def bench_multimodal(peak):
     warmup, measure = (2, 8) if SMOKE else (10, 120)
     # 5 s chunks = the reference speech cadence (audio_io.py:455-460)
     audio_seconds = 1.0 if SMOKE else 5.0
-    batch = 1 if SMOKE else 4  # rows per frame (data_batch_size)
-    micro = 1 if SMOKE else 4  # frames coalesced per jit call
+    # rows per frame (data_batch_size) x frames coalesced per jit call;
+    # env-tunable for scaling experiments
+    batch = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_ROWS", "4"))
+    micro = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_MICRO", "4"))
     max_tokens = 16
     if SMOKE:
         image_size = 64
@@ -474,7 +476,7 @@ def bench_multimodal(peak):
              "parameters": asr, "deploy": _local("SpeechToText")},
             {"name": "text", "input": [{"name": "tokens"}],
              "output": [{"name": "text"}],
-             "parameters": {"workers": 16},
+             "parameters": {"workers": 32},
              "deploy": _local("TokensToText")},
             {"name": "lm", "input": [{"name": "tokens"}],
              "output": [{"name": "logits"}, {"name": "nll"}],

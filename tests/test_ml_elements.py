@@ -561,3 +561,39 @@ def test_detections_publish_element_closes_the_loop():
     _, _, outputs = responses.get(timeout=60)
     assert "Visible objects: person, car." in outputs["prompt"][0]
     process.terminate()
+
+
+def test_meshed_lm_defaults_to_megatron_param_sharding():
+    """A meshed LM element without an explicit sharding.state must NOT
+    replicate its params (an 8B replicated over a pod blows HBM): the
+    megatron param_specs tree is the default."""
+    from jax.sharding import PartitionSpec as P
+    definition = {
+        "name": "sharded_lm",
+        "graph": ["(lm)"],
+        "elements": [
+            {"name": "lm", "input": [{"name": "tokens"}],
+             "output": [{"name": "logits"}, {"name": "nll"}],
+             "parameters": {"vocab_size": 128, "d_model": 32,
+                            "n_layers": 2, "n_heads": 4, "n_kv_heads": 2,
+                            "d_ff": 64, "max_seq_len": 64,
+                            "dtype": "float32"},
+             "sharding": {"axes": {"data": 2, "fsdp": 2, "seq": 1,
+                                   "model": 2}},
+             "deploy": local("LMForward")},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s", queue_response=responses)
+    pipeline.create_frame(
+        stream, {"tokens": np.ones((2, 8), np.int32)})
+    _, _, outputs = responses.get(timeout=60)
+    assert np.asarray(outputs["logits"]).shape == (2, 8, 128)
+    element = pipeline.elements["lm"]
+    wq = element.state["layers"]["wq"]["w"]
+    assert not wq.sharding.is_fully_replicated
+    assert wq.sharding.spec == P(None, "fsdp", "model"), wq.sharding.spec
+    process.terminate()

@@ -551,3 +551,65 @@ def test_micro_batch_mixed_shapes_group_separately():
     # to the full 16 rows (one compilation per trailing shape)
     assert stream.variables["batches"] == [16, 16, 16], stream.variables
     process.terminate()
+
+
+# -- fan-out branch concurrency ----------------------------------------------
+
+class SlowBranch(AsyncHostElement):
+    def process_async(self, stream, number):
+        time.sleep(0.3)
+        stream.variables.setdefault("slow_done", []).append(
+            time.monotonic())
+        return {"slow": number * 2}
+
+
+class FastBranch(PipelineElement):
+    def process_frame(self, stream, number):
+        stream.variables.setdefault("fast_ran", []).append(
+            time.monotonic())
+        return StreamEvent.OKAY, {"fast": number + 1}
+
+
+class Join2(PipelineElement):
+    def process_frame(self, stream, slow, fast):
+        return StreamEvent.OKAY, {"joined": slow + fast}
+
+
+def test_parked_branch_does_not_block_siblings():
+    """A slow async branch must not delay its SIBLING's dispatch (the
+    reference executes branches sequentially; here the fast branch runs
+    while the slow one is parked), and the join still waits for both."""
+    definition = {
+        "name": "fanout_pipe",
+        "graph": ["(source (slow join) (fast join))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [10]},
+             "deploy": local("PE_Number")},
+            {"name": "slow", "input": [{"name": "number"}],
+             "output": [{"name": "slow"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SlowBranch"}}},
+            {"name": "fast", "input": [{"name": "number"}],
+             "output": [{"name": "fast"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "FastBranch"}}},
+            {"name": "join", "input": [{"name": "slow"}, {"name": "fast"}],
+             "output": [{"name": "joined"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "Join2"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    stream, frame, outputs = responses.get(timeout=10)
+    assert outputs["joined"] == (10 * 2) + (10 + 1)
+    fast_ran = stream.variables["fast_ran"][0]
+    slow_done = stream.variables["slow_done"][0]
+    # the fast sibling executed while the slow branch was still parked
+    assert fast_ran < slow_done - 0.25, (fast_ran, slow_done)
+    assert not frame.pending_nodes
+    process.terminate()

@@ -477,3 +477,47 @@ class TestShardedServing:
         assert 1 <= len(collectives) <= budget, (
             f"{len(collectives)} collectives per decode step "
             f"(budget {budget}): {collectives}")
+
+
+class TestLlama38BArchitecture:
+    """The flagship LLAMA3_8B preset instantiated (tiny width, REAL
+    structure: 32 scan layers, 4:1 GQA, untied lm_head, rope 500k) --
+    sharded decode over the full mesh vocabulary with an 8B-style
+    param_specs tree including the untied head."""
+
+    def test_8b_architecture_sharded_decode(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from dataclasses import replace
+        from aiko_services_tpu.models import (
+            cache_specs, generate, init_cache, init_params, param_specs)
+        from aiko_services_tpu.models.configs import LLAMA3_8B
+        from aiko_services_tpu.parallel import filter_specs, shard_pytree
+        from aiko_services_tpu.parallel.mesh import create_mesh
+
+        config = replace(
+            LLAMA3_8B, vocab_size=128, d_model=64, n_layers=32,
+            n_heads=8, n_kv_heads=2, d_ff=96, max_seq_len=64,
+            dtype="float32")
+        assert config.n_layers == LLAMA3_8B.n_layers  # real depth
+        assert (config.n_heads // config.n_kv_heads
+                == LLAMA3_8B.n_heads // LLAMA3_8B.n_kv_heads)  # GQA 4:1
+        params = init_params(config, jax.random.PRNGKey(1))
+        prompt = jnp.asarray(
+            np.random.default_rng(2).integers(3, 120, (2, 8)), jnp.int32)
+        dense_tokens, _ = generate(params, config, prompt, 6)
+
+        mesh = create_mesh({"data": 2, "fsdp": 2, "seq": 1, "model": 2})
+        sharded = shard_pytree(
+            params, mesh,
+            filter_specs(param_specs(config, lm_head="lm_head" in params),
+                         mesh))
+        cache = shard_pytree(
+            init_cache(config, 2, max_len=16), mesh,
+            filter_specs(cache_specs(), mesh))
+        with jax.set_mesh(mesh):
+            sharded_tokens, _ = generate(sharded, config, prompt, 6,
+                                         cache=cache)
+        np.testing.assert_array_equal(np.asarray(dense_tokens),
+                                      np.asarray(sharded_tokens))
