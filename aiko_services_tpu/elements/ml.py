@@ -138,9 +138,12 @@ class LMForward(ComputeElement):
     transformer on the element's mesh.
     """
 
+    def configure(self):
+        if not hasattr(self, "config"):
+            self.config = _transformer_config(self)
+            _default_lm_state_spec(self, self.config)
+
     def setup(self):
-        self.config = _transformer_config(self)
-        _default_lm_state_spec(self, self.config)
         params = _load_transformer_params(self, self.config)
         _LOGGER.info("%s: transformer %.1fM params",
                      self.definition.name, count_params(params) / 1e6)
@@ -199,10 +202,13 @@ class LMGenerate(ComputeElement):
             self._detections_handler = (handler, topic)
             self.process.add_message_handler(handler, topic)
 
+    def configure(self):
+        if not hasattr(self, "config"):
+            self.config = _transformer_config(self)
+            _default_lm_state_spec(self, self.config)
+            self.tokenizer = _tokenizer_for(self)
+
     def setup(self):
-        self.config = _transformer_config(self)
-        _default_lm_state_spec(self, self.config)
-        self.tokenizer = _tokenizer_for(self)
         return _load_transformer_params(self, self.config)
 
     def _format_prompt(self, stream, text: str) -> str:
@@ -352,7 +358,9 @@ class SpeechToText(ComputeElement):
     encoder-decoder transformer run as ONE jit on the element's mesh.
     """
 
-    def setup(self):
+    def configure(self):
+        if hasattr(self, "config"):
+            return
         preset = self.get_parameter("preset")
         if preset:
             self.config = _ASR_PRESETS[str(preset)]
@@ -383,6 +391,8 @@ class SpeechToText(ComputeElement):
         from ..models import asr_param_specs
         _default_state_spec(
             self, lambda: asr_param_specs(self.config))
+
+    def setup(self):
         weights = self.get_parameter("weights")
         if weights:
             # probe the container: HF openai/whisper-* naming loads
@@ -432,8 +442,10 @@ class TextToSpeech(ComputeElement):
     buckets so repeated frames share a compilation; "max_chars"
     (default 512) caps the ladder, warning on truncation."""
 
-    def setup(self):
-        from ..models.tts import TTSConfig, init_tts_params
+    def configure(self):
+        if hasattr(self, "config"):
+            return
+        from ..models.tts import TTSConfig
         self.config = TTSConfig(
             d_model=int(self.get_parameter("d_model", 256)),
             n_conv_layers=int(self.get_parameter("n_conv_layers", 4)),
@@ -443,6 +455,9 @@ class TextToSpeech(ComputeElement):
             griffin_lim_iters=int(
                 self.get_parameter("griffin_lim_iters", 30)),
         )
+
+    def setup(self):
+        from ..models.tts import init_tts_params
         weights = self.get_parameter("weights")
         if weights:
             params = load_pytree(weights, dtype=self.config.dtype)
@@ -561,10 +576,10 @@ class Detector(ComputeElement):
     "rectangles": [...]}) -- detections stay on device; the overlay dict is
     produced lazily by ImageOverlay/host sinks."""
 
-    def _configure(self) -> None:
-        """Idempotent config construction, shared by setup() and the
-        checkpoint-restore path (restore_state installs state WITHOUT
-        calling setup, tpu_element.py).  Probes the weights container:
+    def configure(self) -> None:
+        """Idempotent config construction (ComputeElement.configure hook:
+        runs before BOTH first-frame setup and checkpoint restore).
+        Probes the weights container:
         ultralytics YOLOv8 naming selects the REAL v8 architecture
         (models/yolo.py, BN folded), matching the reference's
         pretrained-YOLO capability (yolo.py:51-54)."""
@@ -620,7 +635,6 @@ class Detector(ComputeElement):
             )
 
     def setup(self):
-        self._configure()
         weights = self.get_parameter("weights")
         if self._yolo:
             from ..models import load_yolov8_params
@@ -639,8 +653,7 @@ class Detector(ComputeElement):
         return params
 
     def process_frame(self, stream, image):
-        self._ensure_ready()
-        self._configure()  # restore_state path never ran setup()
+        self._ensure_ready()  # configure() runs inside
         image = _as_device_array(image, jnp.float32)
         if image.ndim == 3:
             image = image[None]

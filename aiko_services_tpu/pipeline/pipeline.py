@@ -338,8 +338,24 @@ class Pipeline(Actor):
                           self.name, stream_id, frame_id)
             return
         # concurrent branches: responses name their node; remote hops
-        # (exclusive parks) fall back to paused_pe_name
-        resumed_node = (stream_dict.get("node") or frame.paused_pe_name)
+        # (exclusive parks) fall back to paused_pe_name.  An UN-NAMED
+        # response is only routable when at most one park is in flight
+        # (or the fallback holder is the remote hop) -- with several
+        # nameless local parks, attribution would be a guess
+        resumed_node = stream_dict.get("node")
+        if not resumed_node:
+            resumed_node = frame.paused_pe_name
+            holder_is_remote = isinstance(
+                self.elements.get(resumed_node), RemoteElement)
+            if resumed_node is not None and not holder_is_remote and (
+                    len(frame.pending_nodes) > 1):
+                _LOGGER.warning(
+                    "%s: un-named frame response with %d branches in "
+                    "flight on frame %s/%s -- unroutable (elements "
+                    "returning PENDING alongside siblings must name "
+                    "their node in process_frame_response)", self.name,
+                    len(frame.pending_nodes), stream_id, frame_id)
+                return
         if resumed_node is None or (
                 resumed_node not in frame.pending_nodes
                 and resumed_node != frame.paused_pe_name):
@@ -427,10 +443,15 @@ class Pipeline(Actor):
                     encode_frame_data(inputs).encode("ascii"),
                 ])
                 return  # frame stays parked in stream.frames
+            park_start = time.perf_counter()
             if self._try_park_micro(stream, frame, node_name, element,
                                     inputs):
                 if stream.frames.get(frame.frame_id) is not frame:
                     return  # an inline flush already finished the frame
+                # an inline flush ran OTHER frames' passes inside the
+                # park call: exclude that window from THIS frame's
+                # time_pipeline (each resumed frame charged its own)
+                time_start += time.perf_counter() - park_start
                 continue  # parked branch; siblings keep dispatching
             element_start = time.perf_counter()
             stream_event, outputs = self._safe_call(

@@ -114,9 +114,16 @@ class ComputeElement(PipelineElement):
 
     # -- engine ------------------------------------------------------------
 
+    def configure(self) -> None:
+        """Idempotent pre-state configuration hook: build self.config /
+        default self._state_spec here (NOT in setup) so the checkpoint
+        RESTORE path -- which installs state without calling setup() --
+        still configures the element before sharding or compute."""
+
     def _ensure_ready(self):
         if self._compiled is not None:
             return
+        self.configure()
         if self.state is None:  # restore_state may have installed it
             state = self.setup()
             if state is not None and self.mesh is not None:
@@ -200,6 +207,7 @@ class ComputeElement(PipelineElement):
         re-placing it on the element's mesh.  Installed BEFORE
         _ensure_ready so setup() never allocates a fresh params pytree
         that would double peak HBM on the restore path."""
+        self.configure()  # state specs / config must exist before placing
         if state is not None:
             if self.mesh is not None:
                 state = shard_pytree(state, self.mesh, self._state_spec)

@@ -308,6 +308,57 @@ def bench_llm(peak):
             "decode_mfu": _mfu(tokens_per_sec * decode_flops, peak)}
 
 
+# -- config 4c: training step (beyond the reference: it never trains) -------
+
+def bench_train(peak):
+    """make_train_step throughput on the flagship architecture: full
+    fwd+bwd+adamw per step.  Training is where the MXU saturates (big
+    batched matmuls, no decode memory-wall), so this row carries the
+    framework's compute ceiling."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from aiko_services_tpu.models import (
+        count_params, init_params, make_train_step)
+    from aiko_services_tpu.models.configs import LLAMA32_1B, LM_TOY
+    from dataclasses import replace
+
+    if SMOKE:
+        config, name = LM_TOY, "lm_toy"
+        batch, seq, steps = 2, 64, 2
+    else:
+        # 1B-class training on ONE v5e chip: f32 adam moments + grads
+        # need headroom, so train a half-depth variant of the llama32_1b
+        # architecture (8 layers) at seq 1024
+        config = replace(LLAMA32_1B, n_layers=8)
+        name = "llama32_1b architecture, 8 layers"
+        batch, seq, steps = 4, 1024, 8
+    params = init_params(config, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    optimizer = optax.adamw(1e-4)
+    opt_state = optimizer.init(params)
+    train_step = make_train_step(config, optimizer)
+    tokens = jnp.ones((batch, seq + 1), jnp.int32)
+    params, opt_state, loss = train_step(params, opt_state, tokens)  # compile
+    jax.block_until_ready(loss)
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    tokens_per_sec = steps * batch * seq / elapsed
+    # fwd+bwd ~ 6 * params FLOPs per token (+ attention terms omitted:
+    # conservative MFU)
+    flops_per_sec = tokens_per_sec * 6 * n_params
+    return {"model": f"{name} ({n_params / 1e6:.0f}M params)",
+            "batch": batch, "seq_len": seq,
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "step_ms": round(elapsed / steps * 1000, 1),
+            "train_mfu": _mfu(flops_per_sec, peak),
+            "loss_finite": bool(jnp.isfinite(loss))}
+
+
 # -- config 4b: mesh-sharded decode (BASELINE config 4's sharded shape) -----
 
 _SHARDED_SCRIPT = r"""
@@ -547,7 +598,7 @@ def main() -> None:
     import jax
 
     peak = _peak_flops_per_chip()
-    default_configs = "text,asr,detector,llm,llm_sharded,pipeline"
+    default_configs = "text,asr,detector,llm,llm_sharded,train,pipeline"
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -561,6 +612,8 @@ def main() -> None:
         configs["llm"] = bench_llm(peak)
     if "llm_sharded" in wanted:
         configs["llm_sharded"] = bench_llm_sharded()
+    if "train" in wanted:
+        configs["train"] = bench_train(peak)
     headline_fps, headline_p50, audio_seconds = None, None, None
     headline_rows = 1
     if "pipeline" in wanted:

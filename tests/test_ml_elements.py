@@ -597,3 +597,58 @@ def test_meshed_lm_defaults_to_megatron_param_sharding():
     assert not wq.sharding.is_fully_replicated
     assert wq.sharding.spec == P(None, "fsdp", "model"), wq.sharding.spec
     process.terminate()
+
+
+def test_restored_meshed_lm_keeps_megatron_sharding(tmp_path):
+    """Checkpoint restore installs state WITHOUT running setup(): the
+    configure() hook must still default the megatron state spec, or a
+    restored 8B would re-shard fully replicated and blow per-chip HBM."""
+    from jax.sharding import PartitionSpec as P
+    from aiko_services_tpu.utils.checkpoint import Checkpointer
+
+    def definition(name):
+        return {
+            "name": name,
+            "graph": ["(lm)"],
+            "elements": [
+                {"name": "lm", "input": [{"name": "tokens"}],
+                 "output": [{"name": "logits"}, {"name": "nll"}],
+                 "parameters": {"vocab_size": 128, "d_model": 32,
+                                "n_layers": 2, "n_heads": 4,
+                                "n_kv_heads": 2, "d_ff": 64,
+                                "max_seq_len": 64, "dtype": "float32"},
+                 "sharding": {"axes": {"data": 2, "fsdp": 2, "seq": 1,
+                                       "model": 2}},
+                 "deploy": local("LMForward")},
+            ],
+        }
+
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition("ckpt_lm"))
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s", queue_response=responses)
+    pipeline.create_frame(stream, {"tokens": np.ones((2, 8), np.int32)})
+    responses.get(timeout=60)
+    checkpointer = Checkpointer(tmp_path / "ckpt")
+    pipeline.checkpoint(checkpointer, step=1)
+    process.terminate()
+
+    restore_process = Process(transport_kind="loopback")
+    restored = create_pipeline(restore_process, definition("ckpt_lm"))
+    restore_process.run(in_thread=True)
+    restored.restore_checkpoint(checkpointer, step=1)
+    element = restored.elements["lm"]
+    wq = element.state["layers"]["wq"]["w"]
+    assert wq.sharding.spec == P(None, "fsdp", "model"), wq.sharding.spec
+    # and the restored element still serves frames
+    rq = queue.Queue()
+    restored_stream = (restored.streams.get("s")
+                       or restored.create_stream("s2", queue_response=rq))
+    if restored_stream.queue_response is None:
+        restored_stream.queue_response = rq
+    restored.create_frame(restored_stream,
+                          {"tokens": np.ones((2, 8), np.int32)})
+    _, _, outputs = rq.get(timeout=60)
+    assert np.asarray(outputs["logits"]).shape == (2, 8, 128)
+    restore_process.terminate()
