@@ -385,6 +385,21 @@ class SpeechToText(ComputeElement):
                 max_frames=int(self.get_parameter("max_frames", 1500)),
                 dtype=str(self.get_parameter("dtype", "bfloat16")),
             )
+        # HF whisper checkpoints decode between the real special tokens
+        # (<|startoftranscript|> 50258, <|endoftext|> 50257); resolved
+        # HERE (not setup) so the checkpoint-restore path -- which skips
+        # setup -- still decodes with the right ids
+        weights = self.get_parameter("weights")
+        self._hf_weights = False
+        if weights:
+            probe = _probe_weight_names(weights)
+            self._hf_weights = "model.encoder.conv1.weight" in probe
+            probe.close()
+            if self._hf_weights:
+                self.config = replace(
+                    self.config,
+                    sot_token=int(self.get_parameter("sot_token", 50258)),
+                    eot_token=int(self.get_parameter("eot_token", 50257)))
         # meshed ASR defaults to the megatron TP spec tree (HF bias
         # leaves absent from the spec replicate -- correct under
         # global-view SPMD)
@@ -395,22 +410,13 @@ class SpeechToText(ComputeElement):
     def setup(self):
         weights = self.get_parameter("weights")
         if weights:
-            # probe the container: HF openai/whisper-* naming loads
-            # through the whisper name-map (pretrained transcription,
-            # reference speech_elements.py:229-262); otherwise the
-            # framework's own save_pytree layout
-            from ..models import load_whisper_params
-            probe = _probe_weight_names(weights)
-            is_hf = "model.encoder.conv1.weight" in probe
-            probe.close()
-            if is_hf:
-                # HF whisper decodes between the real special tokens
-                # (<|startoftranscript|> 50258, <|endoftext|> 50257);
-                # native checkpoints keep the config's own ids
-                self.config = replace(
-                    self.config,
-                    sot_token=int(self.get_parameter("sot_token", 50258)),
-                    eot_token=int(self.get_parameter("eot_token", 50257)))
+            # container format decided in configure() (restore-safe):
+            # HF openai/whisper-* naming loads through the whisper
+            # name-map (pretrained transcription, reference
+            # speech_elements.py:229-262); otherwise the framework's
+            # own save_pytree layout
+            if self._hf_weights:
+                from ..models import load_whisper_params
                 params = load_whisper_params(weights, self.config)
             else:
                 params = load_pytree(weights, dtype=self.config.dtype)

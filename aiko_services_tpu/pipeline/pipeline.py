@@ -347,14 +347,22 @@ class Pipeline(Actor):
             resumed_node = frame.paused_pe_name
             holder_is_remote = isinstance(
                 self.elements.get(resumed_node), RemoteElement)
+            # only parks that can themselves send an un-named response
+            # create ambiguity: micro-batch parks resume via the flush
+            # path, never through process_frame_response
+            response_capable = sum(
+                1 for node in frame.pending_nodes
+                if not any(entry[0] is frame
+                           for entry in self._micro_pending.get(
+                               (node, stream.stream_id), ())))
             if resumed_node is not None and not holder_is_remote and (
-                    len(frame.pending_nodes) > 1):
+                    response_capable > 1):
                 _LOGGER.warning(
-                    "%s: un-named frame response with %d branches in "
-                    "flight on frame %s/%s -- unroutable (elements "
+                    "%s: un-named frame response with %d async branches "
+                    "in flight on frame %s/%s -- unroutable (elements "
                     "returning PENDING alongside siblings must name "
                     "their node in process_frame_response)", self.name,
-                    len(frame.pending_nodes), stream_id, frame_id)
+                    response_capable, stream_id, frame_id)
                 return
         if resumed_node is None or (
                 resumed_node not in frame.pending_nodes

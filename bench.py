@@ -70,6 +70,23 @@ def _mfu(flops_per_sec, peak):
     return round(flops_per_sec / peak, 4)
 
 
+def _sync(value):
+    """REAL device synchronization.  On the tunneled axon backend
+    jax.block_until_ready returns at dispatch (measured: a 4k-token
+    prefill 'blocks' in 0.1 ms while actual completion takes seconds),
+    so timing loops that end with block_until_ready measure dispatch,
+    not compute.  A one-element dependent readback forces completion of
+    the whole array for ~1 link round-trip, no bulk transfer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    for leaf in jax.tree_util.tree_leaves(value):
+        if hasattr(leaf, "ndim"):
+            np.asarray(jnp.ravel(leaf)[:1])
+            break
+    return value
+
+
 def _run_pipeline(definition, warmup: int, measure: int,
                   ready_key: str, timeout: float = 900,
                   latency_frames: int | None = None):
@@ -99,16 +116,16 @@ def _run_pipeline(definition, warmup: int, measure: int,
                            parameters={"frame_window": 32})
     for _ in range(warmup):
         _, _, outputs = responses.get(timeout=timeout)
-        jax.block_until_ready(outputs[ready_key])
+        _sync(outputs[ready_key])
     start = time.perf_counter()
     for _ in range(measure):
         _, _, outputs = responses.get(timeout=timeout)
-    # block ONCE on the final frame: a single device on a tunneled link
-    # executes dispatches in program order, so "last output ready" means
-    # every measured frame's compute finished -- blocking per frame would
-    # charge one ~100 ms tunnel round-trip to EVERY frame and measure the
-    # link, not the pipeline
-    jax.block_until_ready(outputs[ready_key])
+    # sync ONCE on the final frame: a single device on a tunneled link
+    # executes dispatches in program order, so "last output complete"
+    # means every measured frame's compute finished -- syncing per frame
+    # would charge one ~100 ms tunnel round-trip to EVERY frame and
+    # measure the link, not the pipeline
+    _sync(outputs[ready_key])
     elapsed = time.perf_counter() - start
     pipeline.destroy_stream("bench")
 
@@ -119,7 +136,7 @@ def _run_pipeline(definition, warmup: int, measure: int,
         parameters={"frame_window": 1, "count": latency_frames + 2})
     for index in range(latency_frames):
         _, _, lat_outputs = lat_responses.get(timeout=timeout)
-        jax.block_until_ready(lat_outputs[ready_key])
+        _sync(lat_outputs[ready_key])  # true completion, not dispatch
         if "t0" in lat_outputs:
             latencies.append(time.time() - lat_outputs["t0"])
     pipeline.destroy_stream("latency")
@@ -308,6 +325,66 @@ def bench_llm(peak):
             "decode_mfu": _mfu(tokens_per_sec * decode_flops, peak)}
 
 
+# -- config 4d: long-context prefill (SURVEY: long context first-class) -----
+
+def bench_longcontext(peak):
+    """Flash-attention prefill at long sequence on the flagship
+    architecture: one full causal forward (the serving prefill / scoring
+    path).  The reference handles long audio by CHUNKING (5 s windows,
+    speech_elements.py:54-83) and has no long-context capability at all;
+    this measures the real thing on the chip -- at 16k the quadratic
+    attention term is ~1/3 of total FLOPs, so sustained MFU here proves
+    the Pallas flash kernel, not just the matmuls."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiko_services_tpu.models import (
+        count_params, forward, init_params, transformer_flops_per_token)
+    from aiko_services_tpu.models.configs import LLAMA32_1B, LM_TOY
+    from dataclasses import replace
+
+    if SMOKE:
+        config, name, lengths, batch = LM_TOY, "lm_toy", (128,), 1
+    else:
+        # half-depth llama32_1b architecture (activation headroom at 16k)
+        config = replace(LLAMA32_1B, n_layers=8, max_seq_len=16384)
+        name = "llama32_1b architecture, 8 layers"
+        lengths, batch = (4096, 16384), 1
+    params = init_params(config, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    # jit with a stable identity: raw forward() outside jit re-traces
+    # per call (lax.scan compiles each invocation).  Return ONLY the
+    # last position's logits: the full (L, 128256) tensor is 8.4 GB at
+    # 16k and XLA dead-code-eliminates the unused head positions, so the
+    # measurement covers the transformer body + one head row (the
+    # serving prefill shape: next-token after the prompt)
+    prefill = jax.jit(lambda p, t: forward(p, config, t)[:, -1])
+    rows = {}
+    for length in lengths:
+        tokens = jnp.ones((batch, length), jnp.int32)
+        logits = prefill(params, tokens)  # compile
+        _sync(logits)
+        steps = 2 if SMOKE else 4
+        start = time.perf_counter()
+        for _ in range(steps):
+            logits = prefill(params, tokens)
+        _sync(logits)  # program order: all steps complete
+        elapsed = time.perf_counter() - start
+        tokens_per_sec = steps * batch * length / elapsed
+        # causal prefill: average attended context is length/2 (full
+        # length would overstate MFU); subtract the per-token head term
+        # (2*d*V) since only ONE position's logits are computed
+        per_token = (transformer_flops_per_token(config, length // 2)
+                     - 2 * config.d_model * config.vocab_size)
+        flops = per_token * tokens_per_sec
+        rows[f"seq_{length}"] = {
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "prefill_ms": round(elapsed / steps * 1000, 1),
+            "mfu": _mfu(flops, peak)}
+    return {"model": f"{name} ({n_params / 1e6:.0f}M params)",
+            "batch": batch, "prefill": rows}
+
+
 # -- config 4c: training step (beyond the reference: it never trains) -------
 
 def bench_train(peak):
@@ -341,11 +418,11 @@ def bench_train(peak):
     train_step = make_train_step(config, optimizer)
     tokens = jnp.ones((batch, seq + 1), jnp.int32)
     params, opt_state, loss = train_step(params, opt_state, tokens)  # compile
-    jax.block_until_ready(loss)
+    _sync(loss)
     start = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+    _sync(loss)  # forces the whole dependent step chain to complete
     elapsed = time.perf_counter() - start
     tokens_per_sec = steps * batch * seq / elapsed
     # fwd+bwd ~ 6 * params FLOPs per token (+ attention terms omitted:
@@ -598,7 +675,8 @@ def main() -> None:
     import jax
 
     peak = _peak_flops_per_chip()
-    default_configs = "text,asr,detector,llm,llm_sharded,train,pipeline"
+    default_configs = ("text,asr,detector,llm,llm_sharded,train,"
+                       "longcontext,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -614,6 +692,8 @@ def main() -> None:
         configs["llm_sharded"] = bench_llm_sharded()
     if "train" in wanted:
         configs["train"] = bench_train(peak)
+    if "longcontext" in wanted:
+        configs["longcontext"] = bench_longcontext(peak)
     headline_fps, headline_p50, audio_seconds = None, None, None
     headline_rows = 1
     if "pipeline" in wanted:
