@@ -98,7 +98,6 @@ def _run_pipeline(definition, warmup: int, measure: int,
     completion is true per-frame service latency, not queueing depth.
     Returns (frames/sec, p50 latency s, last outputs).
     """
-    import jax
     import numpy as np
 
     from aiko_services_tpu.pipeline import create_pipeline
@@ -116,7 +115,7 @@ def _run_pipeline(definition, warmup: int, measure: int,
                            parameters={"frame_window": 32})
     for _ in range(warmup):
         _, _, outputs = responses.get(timeout=timeout)
-        _sync(outputs[ready_key])
+    _sync(outputs[ready_key])  # drain once: program order covers all
     start = time.perf_counter()
     for _ in range(measure):
         _, _, outputs = responses.get(timeout=timeout)
@@ -136,9 +135,17 @@ def _run_pipeline(definition, warmup: int, measure: int,
         parameters={"frame_window": 1, "count": latency_frames + 2})
     for index in range(latency_frames):
         _, _, lat_outputs = lat_responses.get(timeout=timeout)
-        _sync(lat_outputs[ready_key])  # true completion, not dispatch
+        # response-arrival latency: dispatch + graph + host stages.  A
+        # per-frame _sync here interacts pathologically with the
+        # tunneled runtime (measured: interleaving readbacks with the
+        # event loop's dispatch stream inflates every frame to ~16 s,
+        # while the same work runs in ms without it), so the device-side
+        # residual is measured ONCE as drain time below.
         if "t0" in lat_outputs:
             latencies.append(time.time() - lat_outputs["t0"])
+    drain_start = time.perf_counter()
+    _sync(lat_outputs[ready_key])  # leftover device backlog, if any
+    drain = time.perf_counter() - drain_start
     pipeline.destroy_stream("latency")
     process.terminate()
     # a stage that drops "t0" would silently degrade p50 into a
@@ -146,6 +153,9 @@ def _run_pipeline(definition, warmup: int, measure: int,
     assert latencies, (
         "no t0 timestamps reached the response: latency was not measured")
     p50 = float(np.percentile(latencies[1:] or latencies, 50))
+    # fold the amortized drain into p50: if the device lagged dispatch,
+    # the backlog divided by the frames charges each frame its share
+    p50 += drain / max(latency_frames, 1)
     return measure / elapsed, p50, outputs
 
 
@@ -229,7 +239,8 @@ def bench_detector(peak):
         DETECTOR_TOY, YOLOV8N_SHAPE)
     config = DETECTOR_TOY if SMOKE else YOLOV8N_SHAPE
     preset = "toy" if SMOKE else "yolov8n"
-    batch = 2 if SMOKE else 8
+    batch = 2 if SMOKE else int(os.environ.get("AIKO_BENCH_DET_BATCH",
+                                               "8"))
     warmup, measure = (2, 6) if SMOKE else (10, 100)
     size = config.image_size
     definition = {
