@@ -182,22 +182,31 @@ def decode_camera_frame(payload) -> "np.ndarray":
                    allow_pickle=False)
 
 
+def _discover_service_topic(process, name) -> str | None:
+    """One-shot registrar lookup: the named service's topic_path (shared
+    by RobotControl proxy resolution and camera discovery)."""
+    from ..runtime import ServiceFilter
+    from ..runtime.share import services_cache_create_singleton
+    cache = services_cache_create_singleton(process)
+    matches = list(cache.services.filter_services(
+        ServiceFilter(name=str(name))))
+    return matches[0].topic_path if matches else None
+
+
 class RobotCameraSource(PipelineElement):
     """DataSource-style element subscribing to a robot's binary video
     topic: each received frame enters the stream as {"image": (3,H,W)}
     (reference capability: xgo_robot camera frames feeding the
     YOLO/overlay pipelines).  Parameters: "topic" (explicit) or
-    "robot_name" (resolves "{ns}/.../{name}"-discovered robot's
-    /video via the registrar would need discovery; topic is the
-    hermetic path)."""
+    "robot_service" (registrar discovery of the named robot's
+    "{topic_path}/video").  Discovery is RACE-FREE: if the robot has
+    not yet reached the services cache at stream start, the element
+    watches the cache and subscribes the moment it appears (the
+    asynchronous mirror means 'not discovered yet' is transient, not
+    an error)."""
 
-    def start_stream(self, stream, stream_id):
-        topic = self.get_parameter("topic", None, stream)
-        if not topic:
-            return StreamEvent.ERROR, {
-                "diagnostic": "RobotCameraSource needs a topic parameter"}
+    def _subscribe(self, stream, topic: str) -> None:
         pipeline = self.pipeline
-
         window = int(self.get_parameter("frame_window", 16, stream))
 
         def handler(_topic, payload):
@@ -216,11 +225,41 @@ class RobotCameraSource(PipelineElement):
                 pipeline.create_frame(stream, {"image": image})
 
         stream.variables[f"{self.definition.name}.handler"] = (
-            handler, str(topic))
-        self.process.add_message_handler(handler, str(topic))
+            handler, topic)
+        self.process.add_message_handler(handler, topic)
+
+    def start_stream(self, stream, stream_id):
+        topic = self.get_parameter("topic", None, stream)
+        name = self.get_parameter("robot_service", None, stream)
+        if topic:
+            self._subscribe(stream, str(topic))
+            return StreamEvent.OKAY, None
+        if not name:
+            return StreamEvent.ERROR, {
+                "diagnostic": "RobotCameraSource needs a topic parameter "
+                              "or a robot_service name"}
+        from ..runtime import ServiceFilter
+        from ..runtime.share import services_cache_create_singleton
+        cache = services_cache_create_singleton(self.process)
+
+        def on_service(command, fields):
+            key = f"{self.definition.name}.handler"
+            if (command == "add" and key not in stream.variables
+                    and stream.stream_id in self.pipeline.streams):
+                self._subscribe(stream, f"{fields.topic_path}/video")
+
+        # add_handler replays already-known services as "add", so this
+        # covers both orders: robot first or stream first
+        cache.add_handler(on_service, ServiceFilter(name=str(name)))
+        stream.variables[f"{self.definition.name}.watch"] = (
+            cache, on_service)
         return StreamEvent.OKAY, None
 
     def stop_stream(self, stream, stream_id):
+        watch = stream.variables.pop(
+            f"{self.definition.name}.watch", None)
+        if watch is not None:
+            watch[0].remove_handler(watch[1])
         entry = stream.variables.pop(
             f"{self.definition.name}.handler", None)
         if entry is not None:
@@ -269,17 +308,13 @@ class RobotControl(PipelineElement):
             return proxy
         if not name:
             return None
-        from ..runtime import ServiceFilter
-        from ..runtime.share import services_cache_create_singleton
-        cache = services_cache_create_singleton(self.process)
-        matches = list(cache.services.filter_services(
-            ServiceFilter(name=str(name))))
-        if not matches:
+        topic_path = _discover_service_topic(self.process, name)
+        if topic_path is None:
             # not cached: retry discovery on the next frame
             _LOGGER.warning("%s: robot service '%s' not discovered yet",
                             self.definition.name, name)
             return None
-        proxy = make_proxy(self.process, matches[0].topic_path)
+        proxy = make_proxy(self.process, topic_path)
         self._proxy_cache = (key, proxy)
         return proxy
 

@@ -122,11 +122,13 @@ def test_pipeline_longcontext_ragged_length_buckets():
 
 def test_pipeline_robot_loop_example_end_to_end():
     """The full reference xgo story, hermetic: robot camera (binary
-    video topic) -> detector -> detections side-channel -> chat LM
-    (vision context injected into the system-prompted request) ->
-    RobotControl driving the robot from (action ...) text."""
+    video topic, resolved by registrar discovery) -> detector ->
+    detections side-channel -> chat LM (vision context injected into
+    the system-prompted request) -> RobotControl driving the robot
+    from (action ...) text."""
     import json
     import queue
+    import time
     from pathlib import Path
 
     import numpy as np
@@ -154,10 +156,11 @@ def test_pipeline_robot_loop_example_end_to_end():
     responses = queue.Queue()
     # multi-root graph: each stream executes ONE root's sub-path
     # (Stream.graph_path, the reference pipeline_paths capability)
+    # no discovery wait needed: the camera element watches the services
+    # cache and subscribes the moment the robot appears
     pipeline.create_stream(
         "vision", queue_response=queue.Queue(), graph_path="camera",
-        grace_time=300,
-        parameters={"camera.topic": f"{robot.topic_path}/video"})
+        grace_time=300)
     robot.start_camera(period=0.1, height=64, width=64)
     # wait for the vision leg (camera -> detector -> publish) to emit on
     # the side-channel BEFORE asking -- detector compile dominates
