@@ -174,8 +174,12 @@ def shard_pytree(tree, mesh: Mesh, specs):
                 else:
                     broadcast = spec_node if spec_leaf(spec_node) else None
                     built = [build(value, broadcast) for value in node]
-                return type(node)(built) if isinstance(node, tuple) else (
-                    built)
+                if isinstance(node, tuple):
+                    # namedtuples (e.g. optax opt_state) take positional
+                    # fields, not an iterable
+                    return (type(node)(*built) if hasattr(node, "_fields")
+                            else type(node)(built))
+                return built
             return named_sharding(
                 mesh, spec_node if spec_leaf(spec_node) else None)
 

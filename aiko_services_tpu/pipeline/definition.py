@@ -84,7 +84,13 @@ def _parse_ports(ports, element_name, direction) -> list:
         _require(isinstance(port, dict) and "name" in port,
                  f"{element_name}: each {direction} port needs a 'name'")
         parsed.append({"name": port["name"],
-                       "type": port.get("type", "any")})
+                       "type": port.get("type", "any"),
+                       # micro-batch contract: batched outputs are split
+                       # per frame by leading-row range; "batched": false
+                       # marks an output as shared by every coalesced
+                       # frame even when its leading dim happens to match
+                       # the batch size (e.g. an NxN affinity matrix)
+                       "batched": bool(port.get("batched", True))})
     return parsed
 
 

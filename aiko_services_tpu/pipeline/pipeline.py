@@ -337,32 +337,53 @@ class Pipeline(Actor):
             _LOGGER.debug("%s: response for unknown frame %s/%s",
                           self.name, stream_id, frame_id)
             return
-        # concurrent branches: responses name their node; remote hops
-        # (exclusive parks) fall back to paused_pe_name.  An UN-NAMED
-        # response is only routable when at most one park is in flight
-        # (or the fallback holder is the remote hop) -- with several
-        # nameless local parks, attribution would be a guess
+        # concurrent branches: responses name their node.  An UN-NAMED
+        # response can only originate from a remote hop (the reply
+        # protocol carries no node) or a CUSTOM PENDING element --
+        # AsyncHostElement replies always name their node, and
+        # micro-batch parks resume via the flush path, so neither is a
+        # candidate for un-named attribution
         resumed_node = stream_dict.get("node")
         if not resumed_node:
-            resumed_node = frame.paused_pe_name
+            holder = frame.paused_pe_name
             holder_is_remote = isinstance(
-                self.elements.get(resumed_node), RemoteElement)
-            # only parks that can themselves send an un-named response
-            # create ambiguity: micro-batch parks resume via the flush
-            # path, never through process_frame_response
-            response_capable = sum(
-                1 for node in frame.pending_nodes
-                if not any(entry[0] is frame
-                           for entry in self._micro_pending.get(
-                               (node, stream.stream_id), ())))
-            if resumed_node is not None and not holder_is_remote and (
-                    response_capable > 1):
+                self.elements.get(holder), RemoteElement)
+            nameless_capable = [
+                node for node in frame.pending_nodes
+                if not isinstance(self.elements.get(node),
+                                  (AsyncHostElement, RemoteElement))
+                and not any(entry[0] is frame
+                            for entry in self._micro_pending.get(
+                                (node, stream.stream_id), ()))]
+            if holder is not None and holder_is_remote:
+                resumed_node = holder   # remote replies are un-named
+            elif (len(nameless_capable) == 1
+                    and not frame.had_remote_park):
+                # exactly one park can have sent this, and no remote hop
+                # ever touched the frame (so it cannot be a delayed
+                # duplicate of a remote reply): unambiguous
+                resumed_node = nameless_capable[0]
+            elif not nameless_capable:
+                # no park can have sent an un-named reply: stale or
+                # duplicate -- falls through to the drop below (in-flight
+                # async branches keep the frame alive and healthy)
+                resumed_node = None
+            else:
+                # several nameless parks (or a possible remote-reply
+                # duplicate): attribution would be a guess.  Don't kill
+                # the frame outright -- arm a watchdog over the doubtful
+                # parks instead, so a misbehaving custom PENDING element
+                # degrades to a delayed dropped frame rather than
+                # permanently holding a backpressure slot, while healthy
+                # named branches in flight stay untouched
                 _LOGGER.warning(
-                    "%s: un-named frame response with %d async branches "
-                    "in flight on frame %s/%s -- unroutable (elements "
-                    "returning PENDING alongside siblings must name "
-                    "their node in process_frame_response)", self.name,
-                    response_capable, stream_id, frame_id)
+                    "%s: un-named frame response unroutable over parks "
+                    "%s on frame %s/%s (custom elements returning "
+                    "PENDING alongside siblings or remote hops must "
+                    "name their node in process_frame_response); park "
+                    "watchdog armed", self.name,
+                    sorted(nameless_capable), stream_id, frame_id)
+                self._arm_park_watchdog(stream, frame, nameless_capable)
                 return
         if resumed_node is None or (
                 resumed_node not in frame.pending_nodes
@@ -427,6 +448,15 @@ class Pipeline(Actor):
             if (node_name in frame.executed
                     or node_name in frame.pending_nodes):
                 continue
+            if frame.pending_nodes and any(
+                    node_name in self.graph.descendants(pending)
+                    for pending in frame.pending_nodes):
+                # downstream of an in-flight branch: defer by graph
+                # reachability, NOT input availability -- an in-flight
+                # element may REWRITE a key this node consumes (e.g.
+                # text -> text), so a swag hit here could be the stale
+                # pre-branch value
+                continue
             stream.current_frame_id = frame.frame_id
             element = self.elements[node_name]
             definition = element.definition
@@ -434,8 +464,9 @@ class Pipeline(Actor):
                 inputs = self._map_in(frame.swag, definition)
             except KeyError as error:
                 if frame.pending_nodes:
-                    # input produced by an in-flight branch: this node
-                    # retries on that branch's resume pass
+                    # input produced off-path by an in-flight branch
+                    # (cross-path key): this node retries on that
+                    # branch's resume pass
                     continue
                 _LOGGER.error("%s: %s missing input %s",
                               self.name, node_name, error)
@@ -444,6 +475,7 @@ class Pipeline(Actor):
             if isinstance(element, RemoteElement):
                 frame.paused_pe_name = node_name
                 frame.pending_nodes.add(node_name)
+                frame.had_remote_park = True
                 element.call("process_frame", [
                     {"stream_id": stream.stream_id,
                      "frame_id": frame.frame_id,
@@ -637,10 +669,14 @@ class Pipeline(Actor):
                     f"only resume one frame); use an AsyncHostElement "
                     f"or micro_batch: 1")}
         if stream_event == StreamEvent.OKAY:
+            shared_outputs = {
+                port["name"] for port in element.definition.output
+                if not port.get("batched", True)}
             offset = 0
             for (frame, _, _), count in zip(group, rows):
                 frame_outputs = self._split_micro_outputs(
-                    outputs or {}, offset, count, target)
+                    outputs or {}, offset, count, target,
+                    shared=shared_outputs)
                 offset += count
                 if stream.frames.get(frame.frame_id) is not frame:
                     continue  # finished on another branch meanwhile
@@ -679,15 +715,21 @@ class Pipeline(Actor):
 
     @classmethod
     def _split_micro_outputs(cls, outputs: dict, offset: int, count: int,
-                             total: int) -> dict:
+                             total: int, shared: set = frozenset()) -> dict:
         """Slice one frame's rows out of a coalesced output: arrays (and
         lists) whose leading size matches the coalesced batch split by
         row range, recursing into nested dicts (e.g. the Detector's
         {"detections": {boxes, scores, ...}} contract); anything else is
-        shared by every frame."""
+        shared by every frame.  Outputs named in `shared` (ports declared
+        "batched": false) are never split -- the escape hatch for a
+        non-batch output whose leading dim coincidentally equals the
+        coalesced batch size."""
         result = {}
         for name, value in outputs.items():
-            if (hasattr(value, "shape") and getattr(value, "ndim", 0) >= 1
+            if name in shared:
+                result[name] = value
+            elif (hasattr(value, "shape")
+                    and getattr(value, "ndim", 0) >= 1
                     and value.shape[0] == total):
                 result[name] = value[offset:offset + count]
             elif isinstance(value, list) and len(value) == total:
@@ -698,6 +740,45 @@ class Pipeline(Actor):
             else:
                 result[name] = value
         return result
+
+    def _arm_park_watchdog(self, stream: Stream, frame: Frame,
+                           doubtful) -> None:
+        """One-shot timer releasing a frame whose park attribution is in
+        doubt: if the DOUBTFUL parks (snapshot at arming) resume normally
+        the watchdog is a no-op -- later parks on other nodes are healthy
+        and must not be killed; if a doubtful park never resumes
+        (misbehaving PENDING element), the frame is released as an error
+        instead of leaking until the stream dies."""
+        if frame.park_watchdog is not None:
+            return  # already armed
+        try:
+            timeout = float(stream.parameters.get("park_timeout", 10.0))
+        except (TypeError, ValueError):
+            timeout = 10.0
+        stream_id, frame_id = stream.stream_id, frame.frame_id
+        doubtful = frozenset(doubtful)
+
+        def expired(_uuid):
+            frame.park_watchdog = None  # always allow a later re-arm
+            live_stream = self.streams.get(stream_id)
+            if live_stream is None:
+                return
+            live_frame = live_stream.frames.get(frame_id)
+            if live_frame is not frame:
+                return  # finished meanwhile
+            still_doubtful = frame.pending_nodes & doubtful
+            if not still_doubtful:
+                return  # ambiguity resolved; any current parks are healthy
+            _LOGGER.warning(
+                "%s: frame %s/%s parks %s still unresolved %.1fs after an "
+                "unroutable response; releasing as error", self.name,
+                stream_id, frame_id, sorted(still_doubtful), timeout)
+            self._finish_frame(live_stream, frame, dropped=True,
+                               error=True)
+
+        frame.park_watchdog = Lease(
+            self.process.event, timeout,
+            f"park:{stream_id}:{frame_id}", lease_expired_handler=expired)
 
     def _safe_call(self, method, *args, **kwargs) -> tuple:
         try:
@@ -719,6 +800,9 @@ class Pipeline(Actor):
                       dropped: bool = False, error: bool = False) -> None:
         if stream.frames.get(frame.frame_id) is not frame:
             return  # already finished (reentrant resume/flush paths)
+        if frame.park_watchdog is not None:
+            frame.park_watchdog.terminate()
+            frame.park_watchdog = None
         # in-flight branch work for this frame must never resume it:
         # strip it from every micro-batch pending list
         if frame.pending_nodes:

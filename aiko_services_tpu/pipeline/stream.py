@@ -56,6 +56,13 @@ class Frame:
     paused_pe_name: str | None = None
     executed: set = field(default_factory=set)       # nodes completed
     pending_nodes: set = field(default_factory=set)  # nodes in flight
+    # armed (a Lease) when an unroutable response leaves the frame's
+    # attribution in doubt: releases the frame if nothing resumes it
+    park_watchdog: object = None
+    # True once a remote hop has parked this frame: un-named replies can
+    # then be delayed duplicates of the remote's, so they are never
+    # auto-routed to a local park
+    had_remote_park: bool = False
 
 
 @dataclass

@@ -48,6 +48,7 @@ class Graph:
         self._head_nodes: list[str] = list(head_nodes or [])
         self._order_cache: list[str] | None = None
         self._path_cache: dict[str, list] = {}
+        self._descendants_cache: dict[str, frozenset] = {}
 
     # -- construction -----------------------------------------------------
 
@@ -92,6 +93,7 @@ class Graph:
                 raise GraphError(f"Bad graph node: {child!r}")
         self._order_cache = None
         self._path_cache.clear()
+        self._descendants_cache.clear()
         return head_name
 
     def _intern(self, token: str, callback) -> str:
@@ -111,6 +113,7 @@ class Graph:
             self._head_nodes.append(node.name)
         self._order_cache = None
         self._path_cache.clear()
+        self._descendants_cache.clear()
 
     # -- queries ----------------------------------------------------------
 
@@ -195,17 +198,38 @@ class Graph:
             return list(cached)
         if head not in self._nodes:
             raise GraphError(f"Unknown graph path head: {head}")
+        reachable = self._reachable_from([head])
+        path = [name for name in order if name in reachable]
+        self._path_cache[head] = path
+        return list(path)
+
+    def _reachable_from(self, starts) -> set:
+        """Transitive closure over successors, INCLUDING the start nodes."""
         reachable: set = set()
-        stack = [head]
+        stack = list(starts)
         while stack:
             name = stack.pop()
             if name in reachable:
                 continue
             reachable.add(name)
             stack.extend(self._nodes[name].successors)
-        path = [name for name in order if name in reachable]
-        self._path_cache[head] = path
-        return list(path)
+        return reachable
+
+    def descendants(self, name: str) -> frozenset:
+        """Every node strictly downstream of `name` (transitive successors,
+        excluding `name` itself).  Cached -- the pipeline engine consults
+        this per node per execution pass to defer descendants of in-flight
+        branches (graph-order data dependencies must hold even when a
+        downstream input key already exists in the swag)."""
+        cached = self._descendants_cache.get(name)
+        if cached is not None:
+            return cached
+        if name not in self._nodes:
+            raise GraphError(f"Unknown node: {name}")
+        result = frozenset(
+            self._reachable_from(self._nodes[name].successors))
+        self._descendants_cache[name] = result
+        return result
 
     def iterate_after(self, name: str, head: str | None = None) -> list:
         """Nodes strictly after `name` in execution order (restricted to

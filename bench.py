@@ -96,7 +96,8 @@ def _run_pipeline(definition, warmup: int, measure: int,
     (frame_window in flight); (2) latency -- a second stream with
     frame_window=1, so exactly one frame is in the system and t0 ->
     completion is true per-frame service latency, not queueing depth.
-    Returns (frames/sec, p50 latency s, last outputs).
+    Returns (frames/sec, p50 arrival latency s, amortized drain s per
+    latency frame, last outputs).
     """
     import numpy as np
 
@@ -154,10 +155,19 @@ def _run_pipeline(definition, warmup: int, measure: int,
     assert latencies, (
         "no t0 timestamps reached the response: latency was not measured")
     p50 = float(np.percentile(latencies[1:] or latencies, 50))
-    # fold the amortized drain into p50: if the device lagged dispatch,
-    # the backlog divided by the frames charges each frame its share
-    p50 += drain / max(latency_frames, 1)
-    return measure / elapsed, p50, outputs
+    # drain is reported SEPARATELY (not folded into p50): if the device
+    # lagged dispatch, drain/latency_frames is each frame's amortized
+    # share of the backlog -- readers see when backlog dominated
+    return measure / elapsed, p50, drain / max(latency_frames, 1), outputs
+
+
+def _latency_fields(p50, drain_pf, digits=2):
+    """The reported latency triple: total (arrival + amortized drain),
+    and its two components, so readers can see when device backlog
+    dominated the measurement."""
+    return {"p50_ms": round((p50 + drain_pf) * 1000, digits),
+            "p50_arrival_ms": round(p50 * 1000, digits),
+            "drain_per_frame_ms": round(drain_pf * 1000, digits)}
 
 
 # -- config 1: text ----------------------------------------------------------
@@ -178,10 +188,10 @@ def bench_text():
              "deploy": _local("TextTransform")},
         ],
     }
-    fps, p50, _ = _run_pipeline(definition, warmup=50, measure=measure,
-                                ready_key="text")
+    fps, p50, drain_pf, _ = _run_pipeline(
+        definition, warmup=50, measure=measure, ready_key="text")
     return {"frames_per_sec": round(fps, 1),
-            "p50_ms": round(p50 * 1000, 3),
+            **_latency_fields(p50, drain_pf, digits=3),
             "vs_reference_broker_ceiling": round(
                 fps / REFERENCE_FRAMES_PER_SEC, 1)}
 
@@ -220,13 +230,13 @@ def bench_asr(peak):
              "deploy": _local("SpeechToText")},
         ],
     }
-    fps, p50, _ = _run_pipeline(definition, warmup=warmup, measure=measure,
-                                ready_key="tokens")
+    fps, p50, drain_pf, _ = _run_pipeline(
+        definition, warmup=warmup, measure=measure, ready_key="tokens")
     n_frames = int(seconds * 100) // 2  # mel 10 ms hop, conv /2
     flops = asr_flops_per_example(config, n_frames, max_tokens) * batch
     return {"frames_per_sec_chip": round(fps, 2),
             "audio_sec_per_sec": round(fps * batch * seconds, 1),
-            "p50_ms": round(p50 * 1000, 2),
+            **_latency_fields(p50, drain_pf),
             "model": preset,
             "batch": batch,
             "mfu": _mfu(fps * flops, peak)}
@@ -261,12 +271,12 @@ def bench_detector(peak):
              "deploy": _local("Detector")},
         ],
     }
-    fps, p50, _ = _run_pipeline(definition, warmup=warmup, measure=measure,
-                                ready_key="detections")
+    fps, p50, drain_pf, _ = _run_pipeline(
+        definition, warmup=warmup, measure=measure, ready_key="detections")
     flops = detector_flops_per_image(config) * batch
     return {"frames_per_sec_chip": round(fps, 2),
             "images_per_sec": round(fps * batch, 1),
-            "p50_ms": round(p50 * 1000, 2),
+            **_latency_fields(p50, drain_pf),
             "model": f"{preset} {size}x{size}",
             "batch": batch,
             "mfu": _mfu(fps * flops, peak)}
@@ -626,8 +636,8 @@ def bench_multimodal(peak):
              "parameters": det, "deploy": _local("Detector")},
         ],
     }
-    fps, p50, _ = _run_pipeline(definition, warmup=warmup, measure=measure,
-                                ready_key="detections")
+    fps, p50, drain_pf, _ = _run_pipeline(
+        definition, warmup=warmup, measure=measure, ready_key="detections")
     # per-frame compute across the three model stages (batch rows each)
     n_frames = int(audio_seconds * 100) // 2
     flops = batch * (
@@ -635,7 +645,7 @@ def bench_multimodal(peak):
         + transformer_flops_per_token(lm_config, max_tokens) * max_tokens
         + detector_flops_per_image(det_config))
     return {"frames_per_sec_chip": round(fps, 2),
-            "p50_ms": round(p50 * 1000, 2),
+            **_latency_fields(p50, drain_pf),
             "audio_seconds_per_frame": audio_seconds,
             "rows_per_frame": batch,
             "audio_realtime_factor": round(
@@ -644,7 +654,8 @@ def bench_multimodal(peak):
                        "yolov8n-640 -> detections" if not SMOKE else
                        "speech->(text,lm) + vision->detections (smoke)"),
             "micro_batch": micro,
-            "mfu": _mfu(fps * flops, peak)}, fps, p50, audio_seconds, batch
+            "mfu": _mfu(fps * flops, peak)}, fps, (p50 + drain_pf), (
+                audio_seconds), batch
 
 
 def _accelerator_failure(timeout: float = 120.0) -> str | None:

@@ -340,6 +340,20 @@ class TestShardPytreeSemantics:
         assert out["block"]["w1"].sharding.spec == P("data", None)
         assert out["block"]["w2"].sharding.spec == P("data", None)
 
+    def test_namedtuple_rebuilt_with_positional_fields(self):
+        # optax opt_states are namedtuples: type(node)(iterable) raises
+        # TypeError for them, the rebuild must splat positionally
+        import collections
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from aiko_services_tpu.parallel import shard_pytree
+        State = collections.namedtuple("State", ["mu", "nu"])
+        mesh = self._mesh()
+        tree = {"opt": State(mu=jnp.zeros((4, 8)), nu=jnp.zeros((4, 8)))}
+        out = shard_pytree(tree, mesh, {"opt": P("data", None)})
+        assert isinstance(out["opt"], State)
+        assert out["opt"].mu.sharding.spec == P("data", None)
+
 
 class TestFlashMultiBlock:
     """Parity BEYOND one kernel block (block_q = block_k = 128): the

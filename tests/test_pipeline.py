@@ -613,3 +613,285 @@ def test_parked_branch_does_not_block_siblings():
     assert fast_ran < slow_done - 0.25, (fast_ran, slow_done)
     assert not frame.pending_nodes
     process.terminate()
+
+
+class SlowRewriter(AsyncHostElement):
+    """Rewrites the SAME key it consumes (text -> text) off-loop."""
+
+    def process_async(self, stream, text):
+        time.sleep(0.2)
+        return {"text": f"GENERATED({text})"}
+
+
+class TextTap(PipelineElement):
+    def process_frame(self, stream, text):
+        stream.variables.setdefault("seen", []).append(text)
+        return StreamEvent.OKAY, {"final": text}
+
+
+def test_descendant_of_pending_branch_defers_on_rewritten_key():
+    """A consumer downstream of an in-flight async element that REWRITES
+    a key it consumes (text -> text) must wait for the rewrite -- a swag
+    hit on the stale pre-branch value is not input availability (graph
+    order defines the data dependency)."""
+    definition = {
+        "name": "rewrite_pipe",
+        "graph": ["(source (rewriter (consumer)))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "text"}],
+             "parameters": {"data_sources": ["PROMPT"]},
+             "deploy": local("TextSource")},
+            {"name": "rewriter", "input": [{"name": "text"}],
+             "output": [{"name": "text"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SlowRewriter"}}},
+            {"name": "consumer", "input": [{"name": "text"}],
+             "output": [{"name": "final"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "TextTap"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    stream, _, outputs = responses.get(timeout=10)
+    assert outputs["final"] == "GENERATED(PROMPT)"
+    assert stream.variables["seen"] == ["GENERATED(PROMPT)"]
+    process.terminate()
+
+
+class ParkForever(PipelineElement):
+    """Custom element that parks the frame and never resumes it itself
+    (a misbehaving PENDING element)."""
+
+    def process_frame(self, stream, number):
+        return StreamEvent.PENDING, {}
+
+
+def test_unroutable_response_arms_watchdog_then_releases_frame():
+    """An UN-NAMED process_frame_response with two nameless parks in
+    flight is unroutable; it must not kill the frame instantly (could be
+    a stale/duplicate reply while healthy branches are in flight) but a
+    watchdog must RELEASE the frame (freeing its backpressure slot) if
+    nothing resumes it, not leave it parked forever."""
+    definition = {
+        "name": "ambiguous_pipe",
+        "graph": ["(source (park_a) (park_b))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [1]},
+             "deploy": local("PE_Number")},
+            {"name": "park_a", "input": [{"name": "number"}],
+             "output": [{"name": "a"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "ParkForever"}}},
+            {"name": "park_b", "input": [{"name": "number"}],
+             "output": [{"name": "b"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "ParkForever"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream(
+        "s1", queue_response=responses,
+        parameters={"park_timeout": 0.3})
+    wait_for(lambda: 0 in stream.frames
+             and len(stream.frames[0].pending_nodes) == 2,
+             timeout=10)
+    # un-named response: with two response-capable parks, unroutable
+    pipeline.process_frame_response(
+        {"stream_id": "s1", "frame_id": 0}, "")
+    # NOT released synchronously: a duplicate reply must not kill a
+    # healthy frame -- the watchdog is armed instead
+    assert 0 in stream.frames
+    assert stream.frames[0].park_watchdog is not None
+    wait_for(lambda: not stream.frames, timeout=10)
+    assert not stream.frames     # frame released, not leaked
+    assert stream.pending == 0   # backpressure slot reclaimed
+    assert "s1" in pipeline.streams  # stream survives (frame-level error)
+    process.terminate()
+
+
+def test_unnamed_response_routes_to_single_remaining_park():
+    """After a named async branch resumes (clearing the fallback slot),
+    an un-named response with exactly ONE remaining response-capable park
+    is unambiguous and must route to it -- not be dropped."""
+    definition = {
+        "name": "single_park_pipe",
+        "graph": ["(source (a) (b))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [4]},
+             "deploy": local("PE_Number")},
+            {"name": "a", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "map_out": {"number": "scaled"},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SlowHostSink"}}},
+            {"name": "b", "input": [{"name": "number"}],
+             "output": [{"name": "b"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "ParkForever"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    # wait until the named async branch (a) resumed: only b remains
+    wait_for(lambda: 0 in stream.frames
+             and stream.frames[0].pending_nodes == {"b"}
+             and stream.frames[0].paused_pe_name is None,
+             timeout=10)
+    pipeline.process_frame_response(
+        {"stream_id": "s1", "frame_id": 0}, {"b": 99})
+    _, frame, outputs = responses.get(timeout=10)
+    assert outputs["scaled"] == 40
+    assert outputs["b"] == 99
+    assert not frame.pending_nodes
+    process.terminate()
+
+
+def test_park_watchdog_scoped_to_doubtful_parks():
+    """Watchdog expiry must only kill the frame if the parks that were
+    IN DOUBT at arming are still pending -- a later healthy park (slow
+    async element) outliving the timeout is not a leak."""
+    definition = {
+        "name": "scoped_watchdog_pipe",
+        "graph": ["(source (a (slow)) (b))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [3]},
+             "deploy": local("PE_Number")},
+            {"name": "a", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "map_out": {"number": "routed"},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "ParkForever"}}},
+            {"name": "b", "input": [{"name": "number"}],
+             "output": [{"name": "b"}],
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "ParkForever"}}},
+            {"name": "slow", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "map_in": {"number": "routed"},
+             "map_out": {"number": "scaled"},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SlowHostSink"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream(
+        "s1", queue_response=responses,
+        parameters={"park_timeout": 0.1})
+    wait_for(lambda: 0 in stream.frames
+             and {"a", "b"} <= stream.frames[0].pending_nodes,
+             timeout=10)
+    # stray un-named response: ambiguous over {a, b} -> watchdog armed
+    pipeline.process_frame_response({"stream_id": "s1", "frame_id": 0}, "")
+    assert stream.frames[0].park_watchdog is not None
+    # both doubtful parks then resolve NAMED; "slow" (0.2 s async, longer
+    # than park_timeout) runs after -- the watchdog must not kill it
+    pipeline.process_frame_response(
+        {"stream_id": "s1", "frame_id": 0, "node": "a"}, {"number": 5})
+    pipeline.process_frame_response(
+        {"stream_id": "s1", "frame_id": 0, "node": "b"}, {"b": 1})
+    _, frame, outputs = responses.get(timeout=10)
+    assert outputs["scaled"] == 50   # slow ran to completion
+    assert outputs["b"] == 1
+    process.terminate()
+
+
+def test_stale_unnamed_response_dropped_when_only_async_parks():
+    """An un-named reply while only ASYNC parks are in flight cannot be
+    theirs (async replies always name their node): it must be dropped as
+    stale, and the frame must complete with the REAL branch outputs."""
+    definition = {
+        "name": "stale_pipe",
+        "graph": ["(source (a) (b))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "number"}],
+             "parameters": {"data_sources": [6]},
+             "deploy": local("PE_Number")},
+            {"name": "a", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "map_out": {"number": "a10"},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SlowHostSink"}}},
+            {"name": "b", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "map_out": {"number": "b10"},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SlowHostSink"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    wait_for(lambda: 0 in stream.frames
+             and len(stream.frames[0].pending_nodes) == 2,
+             timeout=10)
+    # stray un-named reply with a poison payload: must NOT be merged
+    pipeline.process_frame_response(
+        {"stream_id": "s1", "frame_id": 0}, {"number": -999})
+    assert stream.frames[0].park_watchdog is None  # no watchdog either
+    _, frame, outputs = responses.get(timeout=10)
+    assert outputs["a10"] == 60 and outputs["b10"] == 60  # real replies
+    process.terminate()
+
+
+class SharedMatrixBatcher(PipelineElement):
+    """Returns a per-row output AND a matrix whose leading dim equals the
+    coalesced batch size but is NOT batch-major ("batched": false)."""
+
+    def process_frame(self, stream, x):
+        import numpy as np
+        n = int(x.shape[0])
+        return StreamEvent.OKAY, {
+            "y": x * 10,
+            "affinity": np.eye(n, dtype=np.float32)}
+
+
+def test_micro_batch_shared_output_not_split():
+    """An output port declared "batched": false is shared whole by every
+    coalesced frame even when its leading dim equals the batch size."""
+    import numpy as np
+    definition = {
+        "name": "shared_pipe",
+        "graph": ["(batcher)"],
+        "elements": [
+            {"name": "batcher", "input": [{"name": "x"}],
+             "output": [{"name": "y"},
+                        {"name": "affinity", "batched": False}],
+             "parameters": {"micro_batch": 4},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "SharedMatrixBatcher"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    for index in range(4):  # queued before the loop: coalesce to one call
+        pipeline.create_frame(
+            stream, {"x": np.full((1, 2), float(index), np.float32)})
+    process.run(in_thread=True)
+    for _ in range(4):
+        _, frame, outputs = responses.get(timeout=10)
+        # per-row output split: one row each
+        assert np.asarray(outputs["y"]).shape == (1, 2)
+        assert float(np.asarray(outputs["y"])[0, 0]) == frame.frame_id * 10
+        # NxN matrix (N == coalesced batch) arrives WHOLE, not sliced
+        assert np.asarray(outputs["affinity"]).shape == (4, 4)
+    process.terminate()
