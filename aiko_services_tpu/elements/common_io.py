@@ -127,12 +127,22 @@ class DataSource(PipelineElement):
         items = stream.variables[f"{name}.items"]
         batch = int(self.get_parameter("data_batch_size", 1, stream))
         cursor_key = f"{name}.cursor"
-        parts = []
+        batch_items = []
         for _ in range(max(batch, 1)):
             cursor = stream.variables.get(cursor_key, 0)
             stream.variables[cursor_key] = cursor + 1
-            parts.append(self.read_item(stream,
-                                        items[cursor % len(items)]))
+            batch_items.append(items[cursor % len(items)])
+        if batch > 1:
+            # one fused call for the whole row batch when the source
+            # supports it (on tunneled devices per-row synthesis pays
+            # per-dispatch latency ~2-10 ms EACH; a batched source is
+            # one launch per frame)
+            batched = self.read_batch(stream, batch_items)
+            if batched is not None:
+                if self.get_parameter("timestamps", False, stream):
+                    batched["t0"] = time.time()
+                return batched
+        parts = [self.read_item(stream, item) for item in batch_items]
         if batch <= 1:
             frame_data = parts[0]
         else:
@@ -167,6 +177,13 @@ class DataSource(PipelineElement):
 
     def read_item(self, stream, item) -> dict:
         raise NotImplementedError
+
+    def read_batch(self, stream, items) -> dict | None:
+        """Optional whole-batch read: return {key: (B, ...) stacked} for
+        `items`, or None to fall back to per-item read_item() + stack.
+        Sources that can synthesize/load a batch in one device program
+        should implement this (dispatch-latency economy)."""
+        return None
 
     def process_frame(self, stream, **inputs):
         # sources inject frames; a frame passing through is forwarded as-is

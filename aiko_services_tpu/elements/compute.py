@@ -6,6 +6,8 @@
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -92,6 +94,46 @@ class MultiModalSource(DataSource):
             "audio": synthesize_tone(float(item[0]), float(item[1])),
             "image": synthesize_image(shape, seed),
         }
+
+    def read_batch(self, stream, items) -> dict | None:
+        """Whole-row-batch synthesis as ONE device program (B tones + B
+        images in a single dispatch -- the per-item path costs ~10
+        dispatches per frame on a tunneled device).  Host path and
+        ragged tone lengths fall back to per-item reads."""
+        from .audio_io import SAMPLE_RATE
+        if not self.get_parameter("on_device", False, stream):
+            return None
+        seconds = float(items[0][1])
+        if any(float(item[1]) != seconds for item in items):
+            return None  # ragged lengths cannot stack
+        shape = tuple(int(size) for size in self.get_parameter(
+            "image_shape", [3, 32, 32], stream))
+        base_seed = int(self.get_parameter("seed", 0, stream))
+        seeds = np.asarray(
+            [base_seed + self.emission_index(stream) for _ in items],
+            np.uint32)
+        freqs = np.asarray([float(item[0]) for item in items], np.float32)
+        audio, image = _multimodal_batch(
+            jnp.asarray(freqs), jnp.asarray(seeds),
+            int(seconds * SAMPLE_RATE), SAMPLE_RATE, shape)
+        return {"audio": audio, "image": image}
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("samples", "sample_rate", "shape"))
+def _multimodal_batch(freqs, seeds, samples, sample_rate, shape):
+    """(B,) tone frequencies + (B,) seeds -> ((B, samples) audio,
+    (B, *shape) images): the whole multi-modal batch in one dispatch.
+    Same formulas and fold_in as the per-item synthesize_tone_on_device /
+    synthesize_image_on_device; images are bit-exact, audio agrees to
+    f32 rounding (~1e-4 -- XLA fuses the broadcast sin differently)."""
+    t = jnp.arange(samples) / sample_rate
+    audio = jnp.sin(2 * jnp.pi * freqs[:, None] * t[None, :])
+    keys = jax.vmap(
+        lambda seed: jax.random.fold_in(jax.random.PRNGKey(0), seed))(seeds)
+    image = jax.vmap(
+        lambda key: jax.random.uniform(key, shape, jnp.float32))(keys)
+    return audio, image
 
 
 class JaxScale(ComputeElement):

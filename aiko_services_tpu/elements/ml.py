@@ -18,9 +18,8 @@ from ..models import (
     AsrConfig, BPETokenizer, DetectorConfig, TransformerConfig,
     count_params, detect, forward, generate, generate_stream,
     init_asr_params, init_detector_params, init_params, load_llama_params,
-    load_pytree, transcribe)
+    load_pytree)
 from ..models import configs as model_configs
-from ..ops import log_mel_spectrogram
 from ..ops.device import as_device_array as _as_device_array
 from ..pipeline import (
     AsyncHostElement, ComputeElement, PipelineElement, StreamEvent)
@@ -429,14 +428,16 @@ class SpeechToText(ComputeElement):
         return params
 
     def process_frame(self, stream, audio):
+        from ..models.asr import transcribe_audio
         self._ensure_ready()
         audio = _as_device_array(audio, jnp.float32)
         if audio.ndim == 1:
             audio = audio[None]
         max_tokens = int(self.get_parameter("max_tokens", 32, stream))
-        mel = log_mel_spectrogram(audio, n_mels=self.config.n_mels)
-        tokens = transcribe(self.state, self.config, mel,
-                            max_tokens=max_tokens)
+        # frontend + model as ONE launch (transcribe_audio): splitting
+        # them costs a second dispatch round-trip per frame
+        tokens = transcribe_audio(self.state, self.config, audio,
+                                  max_tokens=max_tokens)
         return StreamEvent.OKAY, {"tokens": tokens}
 
 

@@ -424,6 +424,16 @@ class Pipeline(Actor):
         frame.pending_nodes.discard(resumed_node)
         if frame.paused_pe_name == resumed_node:
             frame.paused_pe_name = None
+        if frame.had_remote_park and not any(
+                isinstance(self.elements.get(node), RemoteElement)
+                for node in frame.pending_nodes):
+            # last remote park resumed: un-named replies can again be
+            # attributed to a sole local custom park.  (Residual risk: a
+            # transport-redelivered duplicate of the remote's reply
+            # arriving after this point could be misrouted -- accepted,
+            # since blocking it forever would break every legitimate
+            # custom PENDING element downstream of a remote hop)
+            frame.had_remote_park = False
         self._run_frame(stream, frame, resume_after=resumed_node)
 
     def _run_frame(self, stream: Stream, frame: Frame,
@@ -749,14 +759,17 @@ class Pipeline(Actor):
         and must not be killed; if a doubtful park never resumes
         (misbehaving PENDING element), the frame is released as an error
         instead of leaking until the stream dies."""
+        frame.park_doubtful |= set(doubtful)
         if frame.park_watchdog is not None:
-            return  # already armed
+            # a later unroutable response over DIFFERENT parks: the
+            # union above keeps them covered; restart the clock
+            frame.park_watchdog.extend()
+            return
         try:
             timeout = float(stream.parameters.get("park_timeout", 10.0))
         except (TypeError, ValueError):
             timeout = 10.0
         stream_id, frame_id = stream.stream_id, frame.frame_id
-        doubtful = frozenset(doubtful)
 
         def expired(_uuid):
             frame.park_watchdog = None  # always allow a later re-arm
@@ -766,8 +779,9 @@ class Pipeline(Actor):
             live_frame = live_stream.frames.get(frame_id)
             if live_frame is not frame:
                 return  # finished meanwhile
-            still_doubtful = frame.pending_nodes & doubtful
+            still_doubtful = frame.pending_nodes & frame.park_doubtful
             if not still_doubtful:
+                frame.park_doubtful.clear()
                 return  # ambiguity resolved; any current parks are healthy
             _LOGGER.warning(
                 "%s: frame %s/%s parks %s still unresolved %.1fs after an "

@@ -57,8 +57,11 @@ class Frame:
     executed: set = field(default_factory=set)       # nodes completed
     pending_nodes: set = field(default_factory=set)  # nodes in flight
     # armed (a Lease) when an unroutable response leaves the frame's
-    # attribution in doubt: releases the frame if nothing resumes it
+    # attribution in doubt: releases the frame if nothing resumes it;
+    # park_doubtful accumulates the parks in doubt (unions across
+    # re-arms, pruned of resumed nodes at expiry)
     park_watchdog: object = None
+    park_doubtful: set = field(default_factory=set)
     # True once a remote hop has parked this frame: un-named replies can
     # then be delayed duplicates of the remote's, so they are never
     # auto-routed to a local park

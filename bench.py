@@ -577,14 +577,22 @@ def bench_multimodal(peak):
     audio_seconds = 1.0 if SMOKE else 5.0
     # rows per frame (data_batch_size) x frames coalesced per jit call;
     # env-tunable for scaling experiments
-    batch = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_ROWS", "4"))
+    # rows=16 measured best on v5e: decode is weight-streaming-bound, so
+    # rows are nearly free until compile time / latency push back
+    # (rows 4 -> 8 -> 16: MFU 0.036 -> 0.152 -> 0.239; rows 32 exploded
+    # compile time)
+    batch = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_ROWS", "16"))
     micro = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_MICRO", "4"))
     max_tokens = 16
+    # the LM stage DECODES (greedy, one jit: prefill + fori_loop), the
+    # reference's chat semantics (elements_llm.py:181-210) -- not a
+    # scoring pass
+    max_new = 8 if SMOKE else int(os.environ.get("AIKO_BENCH_NEW", "32"))
     if SMOKE:
         image_size = 64
         lm = dict(vocab_size=1024, d_model=256, n_layers=2, n_heads=8,
                   n_kv_heads=4, d_ff=768, max_seq_len=2048,
-                  dtype="float32")
+                  dtype="float32", max_new_tokens=max_new)
         asr = dict(d_model=64, enc_layers=1, dec_layers=1, n_heads=2,
                    vocab_size=1024, max_tokens=max_tokens, max_frames=192,
                    dtype="float32")
@@ -592,7 +600,8 @@ def bench_multimodal(peak):
                    dtype="float32")
         asr_config = AsrConfig(**{k: v for k, v in asr.items()
                                   if k != "max_tokens"})
-        lm_config = TransformerConfig(**lm)
+        lm_config = TransformerConfig(**{k: v for k, v in lm.items()
+                                         if k != "max_new_tokens"})
         det_config = DetectorConfig(**det)
     else:
         # the flagship presets, by name (BASELINE.md config 5)
@@ -600,7 +609,7 @@ def bench_multimodal(peak):
                "max_tokens": max_tokens, "dtype": "bfloat16",
                "micro_batch": micro}
         lm = {"preset": "llama32_1b", "dtype": "bfloat16",
-              "micro_batch": micro}
+              "micro_batch": micro, "max_new_tokens": max_new}
         det = {"preset": "yolov8n", "dtype": "bfloat16",
                "micro_batch": micro}
         from dataclasses import replace
@@ -610,7 +619,7 @@ def bench_multimodal(peak):
         image_size = det_config.image_size
     definition = {
         "name": "bench_multimodal",
-        "graph": ["(sources (asr (text) (lm)) (detector))"],
+        "graph": ["(sources (asr (text) (lm (reply))) (detector))"],
         "elements": [
             {"name": "sources",
              "output": [{"name": "audio"}, {"name": "image"},
@@ -629,8 +638,14 @@ def bench_multimodal(peak):
              "parameters": {"workers": 32},
              "deploy": _local("TokensToText")},
             {"name": "lm", "input": [{"name": "tokens"}],
-             "output": [{"name": "logits"}, {"name": "nll"}],
-             "parameters": lm, "deploy": _local("LMForward")},
+             "output": [{"name": "generated"}],
+             "parameters": lm, "deploy": _local("LMGenerate")},
+            {"name": "reply", "input": [{"name": "tokens"}],
+             "output": [{"name": "text"}],
+             "map_in": {"tokens": "generated"},
+             "map_out": {"text": "reply"},
+             "parameters": {"workers": 32},
+             "deploy": _local("TokensToText")},
             {"name": "detector", "input": [{"name": "image"}],
              "output": [{"name": "detections"}],
              "parameters": det, "deploy": _local("Detector")},
@@ -640,9 +655,13 @@ def bench_multimodal(peak):
         definition, warmup=warmup, measure=measure, ready_key="detections")
     # per-frame compute across the three model stages (batch rows each)
     n_frames = int(audio_seconds * 100) // 2
+    # LM: prefill over the prompt + max_new decode steps (per-token
+    # flops at the FINAL context slightly overstates the quadratic
+    # attention term; negligible at ctx <= 48 on a 1B)
+    lm_tokens = max_tokens + max_new
     flops = batch * (
         asr_flops_per_example(asr_config, n_frames, max_tokens)
-        + transformer_flops_per_token(lm_config, max_tokens) * max_tokens
+        + transformer_flops_per_token(lm_config, lm_tokens) * lm_tokens
         + detector_flops_per_image(det_config))
     return {"frames_per_sec_chip": round(fps, 2),
             **_latency_fields(p50, drain_pf),
@@ -650,9 +669,12 @@ def bench_multimodal(peak):
             "rows_per_frame": batch,
             "audio_realtime_factor": round(
                 fps * batch * audio_seconds, 2),
-            "stages": ("whisper_small -> (text, llama32_1b) + "
-                       "yolov8n-640 -> detections" if not SMOKE else
-                       "speech->(text,lm) + vision->detections (smoke)"),
+            "tokens_generated_per_frame": batch * max_new,
+            "stages": ("whisper_small -> (text, llama32_1b decode -> "
+                       "reply text) + yolov8n-640 -> detections"
+                       if not SMOKE else
+                       "speech->(text,lm decode) + vision->detections "
+                       "(smoke)"),
             "micro_batch": micro,
             "mfu": _mfu(fps * flops, peak)}, fps, (p50 + drain_pf), (
                 audio_seconds), batch

@@ -47,6 +47,18 @@ class TestAsr:
         assert logits.shape == (2, 8, 64)
         assert bool(jnp.isfinite(logits).all())
 
+    def test_transcribe_matches_rescore_oracle(self):
+        """The incremental KV-cached decode must produce the SAME tokens
+        as the full-rescore loop (the numerics oracle)."""
+        import jax
+        from aiko_services_tpu.models.asr import transcribe_rescore
+        params = init_asr_params(ASR, jax.random.PRNGKey(3))
+        mel = jax.random.normal(
+            jax.random.PRNGKey(4), (2, ASR.n_mels, 64), jnp.float32)
+        fast = transcribe(params, ASR, mel, max_tokens=8)
+        oracle = transcribe_rescore(params, ASR, mel, max_tokens=8)
+        assert jnp.array_equal(fast, oracle), (fast, oracle)
+
     def test_transcribe_greedy(self):
         params = init_asr_params(ASR, jax.random.PRNGKey(0))
         mel = (jax.random.normal(jax.random.PRNGKey(1), (1, 80, 100))

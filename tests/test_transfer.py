@@ -345,7 +345,11 @@ class TestTransferHardening:
         pipeline.create_stream("s1")
         stream = pipeline.streams["s1"]
         frame = Frame(frame_id=0)
+        # park state as the engine produces it: the node is BOTH the
+        # fallback holder and a pending node (un-named responses route
+        # by the pending-parks set)
         frame.paused_pe_name = "add"
+        frame.pending_nodes = {"add"}
         stream.frames[0] = frame
         with socket.socket() as probe:
             probe.bind(("127.0.0.1", 0))
