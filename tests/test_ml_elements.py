@@ -652,3 +652,28 @@ def test_restored_meshed_lm_keeps_megatron_sharding(tmp_path):
     _, _, outputs = rq.get(timeout=60)
     assert np.asarray(outputs["logits"]).shape == (2, 8, 128)
     restore_process.terminate()
+
+
+def test_multimodal_batch_matches_per_item_synth():
+    """read_batch's fused synthesis must match the per-item on-device
+    synthesizers: images bit-exact (same fold_in), audio to f32
+    rounding (XLA fuses the broadcast sin differently)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from aiko_services_tpu.elements.audio_io import (
+        SAMPLE_RATE, synthesize_tone_on_device)
+    from aiko_services_tpu.elements.compute import _multimodal_batch
+    from aiko_services_tpu.elements.image_io import (
+        synthesize_image_on_device)
+    seconds, shape = 0.25, (3, 8, 8)
+    audio, image = _multimodal_batch(
+        jnp.asarray([440.0, 523.25], jnp.float32),
+        jnp.asarray([7, 8], jnp.uint32),
+        int(seconds * SAMPLE_RATE), SAMPLE_RATE, shape)
+    for row, (freq, seed) in enumerate([(440.0, 7), (523.25, 8)]):
+        one_audio = synthesize_tone_on_device(freq, seconds)
+        one_image = synthesize_image_on_device(shape, seed)
+        assert np.allclose(np.asarray(audio[row]), np.asarray(one_audio),
+                           atol=1e-3)
+        assert np.array_equal(np.asarray(image[row]),
+                              np.asarray(one_image))

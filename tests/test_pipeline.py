@@ -547,9 +547,10 @@ def test_micro_batch_mixed_shapes_group_separately():
         _, frame, outputs = responses.get(timeout=10)
         seen[frame.frame_id] = np.asarray(outputs["y"]).shape
     assert seen == {0: (2, 3), 1: (2, 3), 2: (2, 5), 3: (2, 5), 4: (2, 3)}
-    # consecutive same-shape runs coalesce: [0,1] [2,3] [4], each padded
-    # to the full 16 rows (one compilation per trailing shape)
-    assert stream.variables["batches"] == [16, 16, 16], stream.variables
+    # gather-by-signature: [0,1,4] coalesce (same trailing shape, FIFO
+    # by first occurrence) and [2,3] separately, each padded to the full
+    # 16 rows (one compilation per trailing shape)
+    assert stream.variables["batches"] == [16, 16], stream.variables
     process.terminate()
 
 
@@ -894,4 +895,68 @@ def test_micro_batch_shared_output_not_split():
         assert float(np.asarray(outputs["y"])[0, 0]) == frame.frame_id * 10
         # NxN matrix (N == coalesced batch) arrives WHOLE, not sliced
         assert np.asarray(outputs["affinity"]).shape == (4, 4)
+    process.terminate()
+
+
+def test_micro_batch_coalesces_across_streams():
+    """The serving scenario: N streams, one small frame each, coalescing
+    into ONE jit call at the shared element, with each frame's rows
+    routed back to ITS stream's response queue."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, _micro_definition(micro_batch=8))
+    queues = {}
+    for index in range(4):
+        sid = f"s{index}"
+        queues[sid] = queue.Queue()
+        stream = pipeline.create_stream(sid, queue_response=queues[sid])
+        pipeline.create_frame(
+            stream, {"x": np.full((2, 3), float(index), np.float32)})
+    process.run(in_thread=True)
+    for index in range(4):
+        sid = f"s{index}"
+        stream, frame, outputs = queues[sid].get(timeout=10)
+        assert stream.stream_id == sid          # per-stream routing
+        value = np.asarray(outputs["y"])
+        assert value.shape == (2, 3)
+        assert float(value[0, 0]) == index * 10  # own rows, not a neighbor's
+    # all four streams' frames ran as ONE coalesced call (4 x 2 rows,
+    # padded to the full 8 x 2 = 16)
+    batches = []
+    for sid in queues:
+        stream = pipeline.streams.get(sid)
+        if stream and "batches" in stream.variables:
+            batches.extend(stream.variables["batches"])
+    assert batches == [16], batches
+    process.terminate()
+
+
+def test_micro_batch_param_fingerprint_segregates_streams():
+    """Streams resolving the element's parameters differently must NOT
+    share a jit call (the element reads parameters from one lead
+    stream)."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, _micro_definition(micro_batch=8))
+    responses = queue.Queue()
+    s_default = pipeline.create_stream("plain", queue_response=responses)
+    s_scoped = pipeline.create_stream(
+        "tuned", queue_response=responses,
+        parameters={"batcher.gain": 5})  # element-scoped override
+    for stream in (s_default, s_scoped):
+        pipeline.create_frame(
+            stream, {"x": np.ones((2, 3), np.float32)})
+    process.run(in_thread=True)
+    seen = set()
+    for _ in range(2):
+        stream, _, _ = responses.get(timeout=10)
+        seen.add(stream.stream_id)
+    assert seen == {"plain", "tuned"}
+    # two separate coalesced calls: the fingerprints differ
+    lead_batches = []
+    for sid in ("plain", "tuned"):
+        stream = pipeline.streams.get(sid)
+        if stream and "batches" in stream.variables:
+            lead_batches.extend(stream.variables["batches"])
+    assert lead_batches == [16, 16], lead_batches
     process.terminate()
