@@ -122,8 +122,10 @@ class Pipeline(Actor):
         # device arrays; a fresh zeros_like per group is a dispatch)
         self._micro_fillers: dict[tuple, object] = {}
         # open hold-down windows: node -> timer fn (see
-        # _schedule_micro_flush)
+        # _schedule_micro_flush); generations invalidate STALE posted
+        # flush messages from superseded windows
         self._micro_timers: dict[str, object] = {}
+        self._micro_flush_gen: dict[str, int] = {}
         self.share.update({
             "definition_name": definition.name,
             "element_count": len(definition.elements),
@@ -620,13 +622,14 @@ class Pipeline(Actor):
         frames from different streams may share one jit call only when
         both streams resolve the element's parameters identically.
         Covered: element-scoped overrides ("node.param") and bare keys
-        matching the definition's declared parameters -- the two stream
-        override mechanisms.  (A get_parameter name neither declared in
-        the definition nor overridden via scope is not fingerprinted;
-        elements relying on such undeclared per-stream knobs should
-        declare them.)"""
+        matching a declared parameter -- declared at the ELEMENT or the
+        PIPELINE level, both of which get_parameter resolves.  (A
+        get_parameter name declared at neither level nor overridden via
+        scope is not fingerprinted; elements relying on such undeclared
+        per-stream knobs should declare them.)"""
         prefix = node_name + "."
-        declared = set(definition.parameters or ())
+        declared = (set(definition.parameters or ())
+                    | set(self.definition.parameters or ()))
         relevant = [
             (key, repr(value))
             for key, value in (stream.parameters or {}).items()
@@ -690,17 +693,30 @@ class Pipeline(Actor):
         orphan timer would fire early into the next batch's window)."""
         if node_name in self._micro_timers:
             return  # a window is already open
+        gen = self._micro_flush_gen.get(node_name, 0)
 
         def fire():
             self.process.event.remove_timer_handler(fire)
             self._micro_timers.pop(node_name, None)
-            self.post_message("_flush_micro_batch", [node_name])
+            # the generation rides along: if a capacity flush supersedes
+            # this window before the message is processed, it is ignored
+            self.post_message("_flush_micro_batch",
+                              [node_name, None, gen])
 
         self._micro_timers[node_name] = fire
         self.process.event.add_timer_handler(fire, wait_s)
 
-    def _flush_micro_batch(self, element_name, _legacy_stream_id=None):
+    def _flush_micro_batch(self, element_name, _legacy_stream_id=None,
+                           gen=None):
         node_name = str(element_name)
+        if gen is not None and gen != self._micro_flush_gen.get(
+                node_name, 0):
+            # a hold-down timer's posted message from a window that a
+            # capacity flush already superseded: ignoring it keeps it
+            # from prematurely flushing the NEXT accumulating batch
+            return
+        self._micro_flush_gen[node_name] = (
+            self._micro_flush_gen.get(node_name, 0) + 1)
         # a pending hold-down timer is superseded by this flush: cancel
         # it so it cannot fire early into the NEXT accumulating batch
         fire = self._micro_timers.pop(node_name, None)
@@ -775,6 +791,10 @@ class Pipeline(Actor):
                     key = (tuple(arrays[0].shape), str(arrays[0].dtype))
                     filler = self._micro_fillers.get(key)
                     if filler is None:
+                        if len(self._micro_fillers) >= 32:
+                            # bounded: variable-shape workloads must not
+                            # pin device buffers forever
+                            self._micro_fillers.clear()
                         filler = jnp.zeros_like(arrays[0])
                         self._micro_fillers[key] = filler
                     arrays.extend([filler] * fillers)

@@ -1,10 +1,12 @@
-# MQTT transport over paho-mqtt (optional dependency).
+# MQTT transport: paho-mqtt when installed, the in-repo MQTT 3.1.1
+# client (transport/minimqtt.py, real sockets) otherwise.
 #
 # Capability parity with the reference MQTT transport (reference:
 # src/aiko_services/main/message/mqtt.py:65-289): background network thread,
-# LWT set before connect, TLS + username/password, wildcard subscriptions,
-# bounded waits for connect.  Import is gated: environments without
-# paho-mqtt (like this TPU image) use the loopback broker instead.
+# LWT set before connect, TLS + username/password (TLS requires paho),
+# wildcard subscriptions, bounded waits for connect.  With neither paho
+# nor a broker host configured, the loopback broker remains the default
+# transport.
 
 from __future__ import annotations
 
@@ -18,8 +20,10 @@ __all__ = ["MqttTransport", "mqtt_available"]
 try:
     import paho.mqtt.client as _paho
     _PAHO_ERROR = None
-except ImportError as _error:  # gated: loopback is the default transport
-    _paho = None
+except ImportError as _error:
+    # self-contained fallback: the same wire protocol over stdlib
+    # sockets -- MQTT deployment no longer needs the dependency
+    from . import minimqtt as _paho
     _PAHO_ERROR = _error
 
 _LOGGER = get_logger("mqtt")
@@ -27,15 +31,19 @@ _CONNECT_TIMEOUT_SECONDS = 10.0
 
 
 def mqtt_available() -> bool:
-    return _paho is not None
+    """True when an MQTT client implementation is available -- always,
+    since the in-repo minimqtt fallback ships with the package."""
+    return True
+
+
+def paho_available() -> bool:
+    """True when the real paho-mqtt is importable (required for TLS
+    brokers; the minimqtt fallback raises on tls_set)."""
+    return _PAHO_ERROR is None
 
 
 class MqttTransport(Transport):
     def __init__(self, on_message=None, configuration: dict | None = None):
-        if _paho is None:
-            raise ImportError(
-                "paho-mqtt is not installed; use LoopbackTransport "
-                f"(original error: {_PAHO_ERROR})")
         super().__init__(on_message)
         self._configuration = configuration or get_mqtt_configuration()
         self._connected_event = threading.Event()
