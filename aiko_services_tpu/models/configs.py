@@ -20,6 +20,7 @@ __all__ = [
     "WHISPER_TINY", "WHISPER_SMALL",
     "YOLOV8N_SHAPE", "DETECTOR_TOY",
     "transformer_flops_per_token", "asr_flops_per_example",
+    "tts_flops_per_example",
     "detector_flops_per_image",
 ]
 
@@ -99,6 +100,20 @@ def asr_flops_per_example(config: AsrConfig, n_frames: int,
     head = 2 * d * config.vocab_size * n_tokens
     return (config.enc_layers * enc_layer
             + config.dec_layers * dec_layer + head)
+
+
+def tts_flops_per_example(config, n_chars: int) -> float:
+    """chars -> waveform FLOPs: conv stack over upsampled frames + mel
+    head + Griffin-Lim's per-iteration STFT/ISTFT pair as DFT matmuls
+    (tts.py synthesize)."""
+    frames = n_chars * config.frames_per_char
+    d = config.d_model
+    conv = config.n_conv_layers * 2 * config.kernel_size * d * d * frames
+    mel_head = 2 * d * config.n_mels * frames
+    bins = config.n_fft // 2 + 1
+    griffin = (config.griffin_lim_iters
+               * 2 * 2 * frames * config.n_fft * bins)
+    return conv + mel_head + griffin
 
 
 def detector_flops_per_image(config: DetectorConfig) -> float:

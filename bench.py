@@ -821,6 +821,53 @@ def bench_serving(peak):
     }
 
 
+# -- config 7: TTS -----------------------------------------------------------
+
+def bench_tts(peak):
+    """Text -> speech through the pipeline element (chars -> mel ->
+    Griffin-Lim, ONE jit per frame batch): the last model family's
+    on-chip number (reference seat: Coqui TTS on CUDA,
+    speech_elements.py:109-146)."""
+    from aiko_services_tpu.models.configs import tts_flops_per_example
+    from aiko_services_tpu.models.tts import TTSConfig
+
+    phrase = ("the quick brown fox jumps over the lazy dog"
+              if not SMOKE else "hello")
+    batch = 2 if SMOKE else 8
+    warmup, measure = (2, 4) if SMOKE else (5, 40)
+    config = TTSConfig()
+    definition = {
+        "name": "bench_tts",
+        "graph": ["(source (tts))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "text"},
+                                          {"name": "t0"}],
+             "parameters": {"data_sources": [phrase],
+                            "data_batch_size": batch,
+                            "timestamps": True,
+                            "count": (warmup + measure + 4) * batch},
+             "deploy": _local("TextSource")},
+            {"name": "tts", "input": [{"name": "text"}],
+             "output": [{"name": "audio"}, {"name": "sample_rate"}],
+             "deploy": _local("TextToSpeech")},
+        ],
+    }
+    fps, p50, drain_pf, outputs = _run_pipeline(
+        definition, warmup=warmup, measure=measure, ready_key="audio")
+    # REAL speech seconds: the element pads prompts to power-of-two
+    # char buckets, so the waveform length covers pad-silence; count
+    # only the phrase's own frames (matches the FLOPs denominator)
+    seconds = (len(phrase) * config.frames_per_char * config.hop
+               / config.sample_rate)
+    flops = tts_flops_per_example(config, len(phrase)) * batch
+    return {"frames_per_sec_chip": round(fps, 2),
+            **_latency_fields(p50, drain_pf),
+            "audio_seconds_per_frame": round(seconds * batch, 2),
+            "speech_sec_per_sec": round(fps * batch * seconds, 1),
+            "batch": batch,
+            "mfu": _mfu(fps * flops, peak)}
+
+
 def _accelerator_failure(timeout: float = 120.0) -> str | None:
     """Probe device init in a SUBPROCESS (a dead device tunnel makes
     jax.devices() hang forever in-process, which would hang the whole
@@ -862,7 +909,7 @@ def main() -> None:
 
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
-                       "longcontext,serving,pipeline")
+                       "longcontext,serving,tts,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -882,6 +929,8 @@ def main() -> None:
         configs["longcontext"] = bench_longcontext(peak)
     if "serving" in wanted:
         configs["serving"] = bench_serving(peak)
+    if "tts" in wanted:
+        configs["tts"] = bench_tts(peak)
     headline_fps, headline_p50, audio_seconds = None, None, None
     headline_rows = 1
     if "pipeline" in wanted:

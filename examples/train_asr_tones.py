@@ -1,0 +1,136 @@
+"""Train the tiny ASR to TRANSCRIBE: synthetic tone -> word labels.
+
+Functional-correctness proof for the speech seat (reference parity:
+the reference gets transcription from pretrained WhisperX,
+speech_elements.py:229-262; no published checkpoints exist in this
+image, so correctness is established by TRAINING to it): four tone
+classes map to four words; the model must transcribe HELD-OUT tones
+(unseen phase/amplitude draws, plus the clean nominal tone) exactly.
+
+Writes tests/assets/asr_tones.safetensors, consumed by the end-to-end
+pipeline test (tests/test_asr_correctness.py): audio in -> correct
+text out through SpeechToText -> TokensToText.
+
+Run: python examples/train_asr_tones.py   (~1-2 min on CPU)
+"""
+from __future__ import annotations
+
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+SAMPLE_RATE = 16000
+SECONDS = 0.4
+# the byte-level toy vocabulary (elements/ml.py): 0=pad 1=sot 2=eot,
+# 3..258 = bytes
+BYTE_OFFSET = 3
+LABELS = {440.0: "alpha", 523.25: "bravo", 659.25: "charlie",
+          783.99: "delta"}
+TOKEN_WIDTH = 10  # sot + longest word (7) + eot, eot-padded
+
+
+def encode_label(text: str) -> list[int]:
+    data = text.encode("utf-8")
+    tokens = [1] + [BYTE_OFFSET + byte for byte in data] + [2]
+    return tokens + [2] * (TOKEN_WIDTH - len(tokens))
+
+
+def tone_batch(rng, per_class: int):
+    """Jittered training tones: random phase, amplitude, mild noise,
+    +-0.5% frequency wobble."""
+    samples = int(SECONDS * SAMPLE_RATE)
+    t = np.arange(samples) / SAMPLE_RATE
+    audio, tokens = [], []
+    for frequency, label in LABELS.items():
+        for _ in range(per_class):
+            freq = frequency * (1.0 + rng.uniform(-0.005, 0.005))
+            phase = rng.uniform(0, 2 * np.pi)
+            amplitude = rng.uniform(0.4, 1.1)
+            wave = amplitude * np.sin(2 * np.pi * freq * t + phase)
+            # noise level spans CLEAN to noisy: a noiseless tone's
+            # off-tone mel bins sit at the log floor, a different
+            # feature regime than any fixed noise floor -- the clean
+            # nominal tone (the pipeline test input) must be in-dist
+            wave += rng.normal(0, rng.uniform(0.0, 0.02), samples)
+            audio.append(wave.astype(np.float32))
+            tokens.append(encode_label(label))
+    return np.stack(audio), np.asarray(tokens, np.int32)
+
+
+def main() -> int:
+    import jax
+    import optax
+
+    from aiko_services_tpu.models import (
+        AsrConfig, init_asr_params, make_asr_train_step, save_pytree,
+        transcribe_audio)
+
+    config = AsrConfig(
+        n_mels=80, d_model=64, enc_layers=2, dec_layers=2, n_heads=4,
+        vocab_size=259, max_frames=24, max_text_len=16, dtype="float32")
+    params = init_asr_params(config, jax.random.PRNGKey(0))
+    optimizer = optax.adamw(3e-4)
+    opt_state = optimizer.init(params)
+    train_step = make_asr_train_step(config, optimizer)
+
+    from aiko_services_tpu.ops import log_mel_spectrogram
+    mel_fn = jax.jit(
+        lambda audio: log_mel_spectrogram(audio, n_mels=config.n_mels))
+
+    rng = np.random.default_rng(7)
+    heldout_rng = np.random.default_rng(1234)
+    heldout_audio, heldout_tokens = tone_batch(heldout_rng, per_class=4)
+    # plus the clean nominal tone per class (what the pipeline test uses)
+    samples = int(SECONDS * SAMPLE_RATE)
+    t = np.arange(samples) / SAMPLE_RATE
+    clean = np.stack([
+        np.sin(2 * np.pi * freq * t).astype(np.float32)
+        for freq in LABELS])
+    clean_tokens = np.asarray(
+        [encode_label(label) for label in LABELS.values()], np.int32)
+    heldout_audio = np.concatenate([heldout_audio, clean])
+    heldout_tokens = np.concatenate([heldout_tokens, clean_tokens])
+
+    def heldout_exact() -> bool:
+        out = np.asarray(transcribe_audio(
+            params, config, heldout_audio, max_tokens=TOKEN_WIDTH - 1))
+        return bool(np.array_equal(out, heldout_tokens[:, 1:]))
+
+    loss = float("nan")
+    for step in range(1, 2001):
+        audio, tokens = tone_batch(rng, per_class=8)
+        mel = mel_fn(audio)
+        params, opt_state, loss = train_step(params, opt_state, mel,
+                                             tokens)
+        if step % 50 == 0:
+            exact = heldout_exact()
+            print(f"step {step}: loss {float(loss):.4f} "
+                  f"heldout_exact={exact}", flush=True)
+            if exact and float(loss) < 0.01:
+                break
+    if not heldout_exact():
+        print("FAILED: held-out tones not transcribed exactly")
+        return 1
+
+    asset = (pathlib.Path(__file__).resolve().parent.parent
+             / "tests" / "assets" / "asr_tones.safetensors")
+    asset.parent.mkdir(parents=True, exist_ok=True)
+    save_pytree(asset, params, metadata={
+        "config": {
+            "n_mels": config.n_mels, "d_model": config.d_model,
+            "enc_layers": config.enc_layers,
+            "dec_layers": config.dec_layers, "n_heads": config.n_heads,
+            "vocab_size": config.vocab_size,
+            "max_frames": config.max_frames,
+            "max_text_len": config.max_text_len, "dtype": config.dtype},
+        "labels": {str(freq): label for freq, label in LABELS.items()},
+        "seconds": SECONDS})
+    print(f"saved {asset} ({asset.stat().st_size / 1024:.0f} KiB)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

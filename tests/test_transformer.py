@@ -521,3 +521,119 @@ class TestLlama38BArchitecture:
                                          cache=cache)
         np.testing.assert_array_equal(np.asarray(dense_tokens),
                                       np.asarray(sharded_tokens))
+
+
+class TestLlama8BFeasibility:
+    """BASELINE config 4 feasibility: the REAL Llama-3-8B layout must
+    FIT a v5e-8 serving mesh (VERDICT r3 item 7) -- checked by
+    eval_shape (no weights materialize) against the published
+    param_specs sharding and the serving KV cache."""
+
+    V5E_HBM_BYTES = 16 * 1024**3          # per chip
+    BUDGET = 0.90                          # leave 10% for XLA scratch
+
+    def _per_device_bytes(self, shapes, specs, mesh_axes):
+        """Bytes per device for a pytree of ShapeDtypeStructs sharded by
+        PartitionSpecs over named mesh axis sizes (replicated where the
+        spec names no axis)."""
+        import numpy as np
+
+        import jax
+
+        total = 0
+        flat_shapes, _ = jax.tree_util.tree_flatten(shapes)
+        flat_specs, _ = jax.tree_util.tree_flatten(
+            specs, is_leaf=lambda x: isinstance(
+                x, jax.sharding.PartitionSpec))
+        assert len(flat_shapes) == len(flat_specs)
+        for struct, spec in zip(flat_shapes, flat_specs):
+            divisor = 1
+            for entry in tuple(spec):
+                names = (entry if isinstance(entry, tuple)
+                         else (entry,) if entry else ())
+                for name in names:
+                    divisor *= mesh_axes.get(name, 1)
+            total += (int(np.prod(struct.shape)) // divisor
+                      * struct.dtype.itemsize)
+        return total
+
+    def test_8b_params_and_cache_fit_v5e8(self):
+        import jax
+
+        from aiko_services_tpu.models import (
+            cache_specs, init_cache, init_params, param_specs)
+        from aiko_services_tpu.models.configs import LLAMA3_8B
+
+        config = LLAMA3_8B
+        # the serving mesh from examples/pipeline_llm_8b.json
+        mesh_axes = {"data": 1, "fsdp": 2, "seq": 1, "model": 4}
+        shapes = jax.eval_shape(
+            lambda: init_params(config, jax.random.PRNGKey(0)))
+        has_head = "lm_head" in shapes
+        specs = param_specs(config, lm_head=has_head)
+        specs = {key: specs[key] for key in shapes}  # align partial tree
+        param_bytes = self._per_device_bytes(shapes, specs, mesh_axes)
+
+        # serving KV cache: batch 8, full 8k context
+        batch, max_len = 8, config.max_seq_len
+        cache_shapes = jax.eval_shape(
+            lambda: init_cache(config, batch, max_len=max_len))
+        cache_bytes = self._per_device_bytes(
+            cache_shapes, cache_specs(), mesh_axes)
+
+        # activations at decode (1 token) are noise; prefill peak ~
+        # batch x seq x d x a-few in bf16 under remat -- bound it
+        # generously
+        activation_bytes = 2 * batch * max_len * config.d_model * 8
+
+        used = param_bytes + cache_bytes + activation_bytes
+        budget = self.V5E_HBM_BYTES * self.BUDGET
+        # HBM budget table (mirrored in BENCH_NOTES.md):
+        #   params/device   2.11 GiB (8.03B bf16 over fsdp2 x model4)
+        #   kv cache/device 2.00 GiB (batch 8 x 8k, GQA 8 kv heads / 4)
+        #   activations     4.00 GiB bound
+        #   total           8.11 GiB vs 14.4 GiB budget
+        assert used < budget, (
+            f"8B does not fit: params {param_bytes/2**30:.2f} GiB + "
+            f"cache {cache_bytes/2**30:.2f} GiB + activations "
+            f"{activation_bytes/2**30:.2f} GiB = {used/2**30:.2f} GiB "
+            f"> budget {budget/2**30:.2f} GiB")
+        # and the whole thing genuinely needed sharding: replicated
+        # (params 15.0 GiB + cache + activations) blows the same budget
+        replicated = self._per_device_bytes(shapes, specs, {})
+        assert replicated + cache_bytes + activation_bytes > budget
+
+    def test_8b_pipeline_definition_compiles_on_virtual_mesh(self):
+        """examples/pipeline_llm_8b.json executes end to end on the
+        virtual 8-CPU mesh at ARCHITECTURE dims (real depth/GQA/mesh
+        layout, tiny width -- materializing 16 GB of weights on the
+        test host is the only thing skipped)."""
+        import json
+        import pathlib
+        import queue
+
+        from aiko_services_tpu.pipeline import create_pipeline
+        from aiko_services_tpu.runtime import Process
+
+        path = (pathlib.Path(__file__).parent.parent / "examples"
+                / "pipeline_llm_8b.json")
+        definition = json.loads(path.read_text())
+        lm = next(element for element in definition["elements"]
+                  if element["name"] == "lm")
+        # architecture dims: REAL depth + GQA ratio + the json's mesh
+        # layout; width shrunk so the test host can materialize it
+        lm["parameters"].pop("preset")
+        lm["parameters"].update({
+            "vocab_size": 256, "d_model": 64, "n_layers": 32,
+            "n_heads": 8, "n_kv_heads": 2, "d_ff": 224,
+            "max_seq_len": 512, "dtype": "float32",
+            "max_new_tokens": 4, "tokenizer": "default",
+            "stream_tokens": False})
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, definition)
+        process.run(in_thread=True)
+        responses = queue.Queue()
+        pipeline.create_stream("s1", queue_response=responses)
+        _, _, outputs = responses.get(timeout=300)
+        assert "generated" in outputs and "text" in outputs
+        process.terminate()
