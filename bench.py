@@ -299,8 +299,14 @@ def bench_detector(peak):
         DETECTOR_TOY, YOLOV8N_SHAPE)
     config = DETECTOR_TOY if SMOKE else YOLOV8N_SHAPE
     preset = "toy" if SMOKE else "yolov8n"
+    # the detect call has a ~38 ms per-call latency floor for ANY
+    # batch <= 32 (BENCH_NOTES detector roofline), so bigger batches
+    # win; batch 32 however OOMs: the in-flight working set is images
+    # (frame_window 32 x 157 MB = 5 GB) PLUS every queued call's
+    # activation footprint (~30 MB/image), together past 16 GiB.
+    # 16 is the deployable sweet spot (1,099 images/s measured)
     batch = 2 if SMOKE else int(os.environ.get("AIKO_BENCH_DET_BATCH",
-                                               "8"))
+                                               "16"))
     warmup, measure = (2, 6) if SMOKE else (10, 100)
     size = config.image_size
     definition = {
