@@ -16,7 +16,7 @@ from .asr import (                                            # noqa: F401
     transcribe_audio)
 from .detector import (                                       # noqa: F401
     DetectorConfig, init_detector_params, detect, detector_forward,
-    decode_boxes, non_max_suppression)
+    decode_boxes, make_detector_train_step, non_max_suppression)
 from .yolo import (                                           # noqa: F401
     YoloV8Config, YOLOV8N, YOLO_VARIANTS, init_yolo_params,
     infer_yolov8_config, load_yolov8_params, yolo_forward, yolo_detect)
