@@ -960,3 +960,76 @@ def test_micro_batch_param_fingerprint_segregates_streams():
             lead_batches.extend(stream.variables["batches"])
     assert lead_batches == [16, 16], lead_batches
     process.terminate()
+
+def test_micro_batch_undeclared_param_segregates_streams():
+    """ADVICE r4 (medium): a per-stream override of a knob the element
+    reads via get_parameter(name, default) that is DECLARED NOWHERE
+    (neither element- nor pipeline-level, not node-prefixed) must still
+    block cross-stream coalescing -- the old declared-only fingerprint
+    silently shared one jit call resolved under the lead stream's
+    values."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, _micro_definition(micro_batch=8))
+    responses = queue.Queue()
+    s_default = pipeline.create_stream("plain", queue_response=responses)
+    s_tuned = pipeline.create_stream(
+        "tuned", queue_response=responses,
+        parameters={"gain": 5})  # bare key, declared nowhere
+    for stream in (s_default, s_tuned):
+        pipeline.create_frame(
+            stream, {"x": np.ones((2, 3), np.float32)})
+    process.run(in_thread=True)
+    seen = set()
+    for _ in range(2):
+        stream, _, _ = responses.get(timeout=10)
+        seen.add(stream.stream_id)
+    assert seen == {"plain", "tuned"}
+    lead_batches = []
+    for sid in ("plain", "tuned"):
+        stream = pipeline.streams.get(sid)
+        if stream and "batches" in stream.variables:
+            lead_batches.extend(stream.variables["batches"])
+    # two separate coalesced calls, NOT one shared one
+    assert lead_batches == [16, 16], lead_batches
+    process.terminate()
+
+
+def test_micro_batch_array_param_fingerprint_by_content():
+    """ADVICE r4 (medium): ndarray-valued stream parameters fingerprint
+    by CONTENT; repr() truncates large arrays, letting different values
+    compare equal and share a call."""
+    import numpy as np
+    big_a = np.zeros(10_000, np.float32)
+    big_b = np.zeros(10_000, np.float32)
+    big_b[5_000] = 1.0  # differs only in repr's truncated middle
+    assert repr(big_a) == repr(big_b)  # the failure mode being fixed
+    from aiko_services_tpu.pipeline.pipeline import _canonical_value
+    assert _canonical_value(big_a) != _canonical_value(big_b)
+    assert _canonical_value(big_a) == _canonical_value(np.zeros(
+        10_000, np.float32))
+    # dict ordering is canonical
+    assert _canonical_value({"a": 1, "b": 2}) == _canonical_value(
+        {"b": 2, "a": 1})
+
+
+def test_micro_batch_per_signature_capacity_flush():
+    """ADVICE r4 (low): capacity must count per SIGNATURE -- two
+    interleaved shape cohorts at micro_batch=4 each fill to a full
+    4-frame group instead of chronically flushing 2+2 partials when the
+    combined count hits 4."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, _micro_definition(micro_batch=4))
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    shapes = [(2, 3), (2, 5)] * 4  # A B A B A B A B
+    for index, shape in enumerate(shapes):
+        pipeline.create_frame(
+            stream, {"x": np.full(shape, float(index), np.float32)})
+    process.run(in_thread=True)
+    for _ in range(len(shapes)):
+        responses.get(timeout=10)
+    # one FULL group per cohort (4 frames x 2 rows = 8), not 4 partials
+    assert stream.variables["batches"] == [8, 8], stream.variables
+    process.terminate()
