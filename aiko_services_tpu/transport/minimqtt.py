@@ -343,7 +343,15 @@ class Client:
         self._sock = None
         self._thread = None
         self._connected = threading.Event()
-        self._ping_event = threading.Event()
+        # ping accounting: flush() must wait for ITS OWN PINGREQ's
+        # response, not any PINGRESP (a keepalive ping answered just
+        # after flush starts must not satisfy the barrier), so pings
+        # are counted and flush waits for acked >= the count it
+        # observed at send time; PINGRESPs arrive in request order
+        self._ping_cond = threading.Condition()
+        self._ping_sent = 0
+        self._ping_acked = 0
+        self._ping_gen = 0  # bumped per connection loss: aborts waiters
         self._packet_id = 0
         self._write_lock = threading.Lock()
         self._host = None
@@ -426,10 +434,36 @@ class Client:
     def flush(self, timeout: float = 5.0) -> bool:
         """PINGREQ round-trip: barrier over everything this client sent
         AND every delivery the broker wrote to this socket before the
-        PINGRESP."""
-        self._ping_event.clear()
-        self._send(_packet(PINGREQ, 0, b""))
-        return self._ping_event.wait(timeout)
+        PINGRESP.  Waits for the response to THIS flush's own PINGREQ
+        (counted, not an any-ping event): a PINGRESP answering an
+        earlier keepalive ping cannot release the barrier early."""
+        with self._ping_cond:
+            generation = self._ping_gen
+            self._ping_sent += 1
+            target = self._ping_sent
+        if self._send(_packet(PINGREQ, 0, b"")) != 0:
+            with self._ping_cond:
+                # roll the phantom count back: a ping that never hit
+                # the wire gets no PINGRESP, and (unlike the keepalive
+                # path, whose send failure is followed by the read
+                # loop's connection-loss resync) nothing else would
+                # ever clear the deficit -- every later flush() would
+                # time out until the next disconnect
+                if self._ping_gen == generation:
+                    self._ping_sent -= 1
+            return False
+        deadline = _time.monotonic() + timeout
+        with self._ping_cond:
+            while (self._ping_acked < target
+                   and self._ping_gen == generation):
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._ping_cond.wait(remaining)
+            # a connection loss resyncs acked=sent, which would satisfy
+            # the count -- the generation check keeps a voided barrier
+            # from reporting success
+            return self._ping_gen == generation
 
     # internals -------------------------------------------------------------
 
@@ -463,8 +497,12 @@ class Client:
             if self._password is not None:
                 connect_flags |= 0x40
                 tail += _encode_string(self._password)
+        # advertise the REAL keepalive: a hardcoded 60 here with a
+        # client pinging at self._keepalive/2 lets a real broker's
+        # 1.5x-keepalive idle cutoff (90 s) fire before the first ping
+        # whenever keepalive > 90
         return (_encode_string("MQTT") + bytes([4, connect_flags])
-                + struct.pack(">H", 60) + tail)
+                + struct.pack(">H", min(self._keepalive, 0xFFFF)) + tail)
 
     def _network_loop(self) -> None:
         """Connect / read / keepalive / reconnect, paho-style: recv
@@ -488,6 +526,13 @@ class Client:
                     _LOGGER.debug("minimqtt connect failed: %s", error)
             was_connected = self._connected.is_set()
             self._connected.clear()
+            with self._ping_cond:
+                # outstanding pings died with the socket: resync the
+                # counters and wake flush() waiters so they fail fast
+                # instead of timing out on a response that cannot come
+                self._ping_gen += 1
+                self._ping_acked = self._ping_sent
+                self._ping_cond.notify_all()
             if self._closing:
                 return
             if was_connected and self.on_disconnect is not None:
@@ -500,6 +545,8 @@ class Client:
             try:
                 packet = _read_packet(sock)
             except socket.timeout:
+                with self._ping_cond:
+                    self._ping_sent += 1
                 self._send(_packet(PINGREQ, 0, b""))  # keepalive
                 continue
             if packet is None:
@@ -516,5 +563,7 @@ class Client:
                     self.on_message(self, None,
                                     _Message(topic, reader.rest))
             elif packet_type == PINGRESP:
-                self._ping_event.set()
+                with self._ping_cond:
+                    self._ping_acked += 1
+                    self._ping_cond.notify_all()
             # PUBACK/SUBACK/UNSUBACK: fire-and-forget acks

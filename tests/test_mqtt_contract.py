@@ -305,3 +305,80 @@ class TestProcessOverMqtt:
         assert fields is not None and fields.name == "mqtt_actor"
         worker.terminate()
         registrar_process.terminate()
+
+class TestMiniMqttClientUnit:
+    """ADVICE r4 (low x2): CONNECT advertises the real keepalive, and
+    flush() waits for its OWN ping's response."""
+
+    def test_connect_body_encodes_real_keepalive(self):
+        import struct
+        client = minimqtt.Client()
+        client.connect_async("localhost", 1883, keepalive=300)
+        body = client._connect_body()
+        # body = len-prefixed "MQTT" (6) + level (1) + flags (1) + keepalive
+        assert struct.unpack(">H", body[8:10])[0] == 300
+
+    def test_flush_fails_fast_when_disconnected(self):
+        import time
+        client = minimqtt.Client()  # no socket at all
+        start = time.monotonic()
+        assert client.flush(timeout=5.0) is False
+        assert time.monotonic() - start < 1.0  # no blind timeout wait
+
+    def test_flush_not_released_by_earlier_keepalive_pingresp(self):
+        import threading
+        import time
+
+        class _FakeSock:
+            def sendall(self, data):
+                pass
+
+        client = minimqtt.Client()
+        client._sock = _FakeSock()
+        # a keepalive PINGREQ is already outstanding when flush starts
+        with client._ping_cond:
+            client._ping_sent += 1
+        result = {}
+
+        def run_flush():
+            result["ok"] = client.flush(timeout=5.0)
+
+        thread = threading.Thread(target=run_flush)
+        thread.start()
+        time.sleep(0.1)
+        # the keepalive's PINGRESP arrives: must NOT satisfy the barrier
+        with client._ping_cond:
+            client._ping_acked += 1
+            client._ping_cond.notify_all()
+        time.sleep(0.2)
+        assert thread.is_alive()  # still waiting for ITS OWN response
+        with client._ping_cond:
+            client._ping_acked += 1
+            client._ping_cond.notify_all()
+        thread.join(timeout=5.0)
+        assert result["ok"] is True
+
+    def test_flush_aborts_on_connection_loss(self):
+        import threading
+        import time
+
+        class _FakeSock:
+            def sendall(self, data):
+                pass
+
+        client = minimqtt.Client()
+        client._sock = _FakeSock()
+        result = {}
+
+        def run_flush():
+            result["ok"] = client.flush(timeout=5.0)
+
+        thread = threading.Thread(target=run_flush)
+        thread.start()
+        time.sleep(0.1)
+        with client._ping_cond:  # what _network_loop does on socket loss
+            client._ping_gen += 1
+            client._ping_acked = client._ping_sent
+            client._ping_cond.notify_all()
+        thread.join(timeout=5.0)
+        assert result["ok"] is False
