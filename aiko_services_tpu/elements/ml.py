@@ -54,6 +54,9 @@ def _transformer_config(element) -> TransformerConfig:
     from ..utils import truthy
     sequence_parallel = truthy(
         element.get_parameter("sequence_parallel", False))
+    # "int8" halves KV-cache HBM and read bandwidth (serving batch
+    # headroom); numerics pinned in tests/test_transformer.py
+    kv_dtype = str(element.get_parameter("kv_dtype", "") or "")
     preset = element.get_parameter("preset")
     if preset:
         config = _LM_PRESETS[str(preset)]
@@ -62,6 +65,8 @@ def _transformer_config(element) -> TransformerConfig:
             config = replace(config, dtype=str(dtype))
         if sequence_parallel:
             config = replace(config, sequence_parallel=True)
+        if kv_dtype:
+            config = replace(config, kv_dtype=kv_dtype)
         return config
     return TransformerConfig(
         vocab_size=int(element.get_parameter("vocab_size", 8192)),
@@ -73,6 +78,7 @@ def _transformer_config(element) -> TransformerConfig:
         max_seq_len=int(element.get_parameter("max_seq_len", 2048)),
         dtype=str(element.get_parameter("dtype", "bfloat16")),
         sequence_parallel=sequence_parallel,
+        kv_dtype=kv_dtype,
     )
 
 

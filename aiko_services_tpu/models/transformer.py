@@ -83,12 +83,28 @@ class TransformerConfig:
     # shard on the "expert" axis, where the dispatch einsum keeps weights
     # stationary and moves (tiny) tokens instead.
     moe_decode_gather: bool = True
+    # "int8": KV cache stores 8-bit codes + a per-(head, position) f32
+    # scale -- halves cache HBM (doubling feasible decode batch at fixed
+    # memory) and halves the cache-read bandwidth that bounds decode.
+    # "" keeps the compute dtype.  Quantization happens at cache WRITE
+    # (one rounding per token ever); reads dequantize into the attention
+    # einsum, which XLA fuses into the operand load.
+    kv_dtype: str = ""
 
     def __post_init__(self):
         if self.sp_mechanism not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_mechanism must be 'ring' or 'ulysses', got "
                 f"{self.sp_mechanism!r}")
+        if self.kv_dtype not in ("", "int8"):
+            raise ValueError(
+                f"kv_dtype must be '' (compute dtype) or 'int8', got "
+                f"{self.kv_dtype!r}")
+        if self.kv_dtype == "int8" and self.sequence_parallel:
+            raise ValueError(
+                "kv_dtype='int8' is not supported on the "
+                "sequence-parallel decode path (sp_decode_attention "
+                "reads the raw cache shards)")
 
     @property
     def head_dim(self) -> int:
@@ -191,27 +207,51 @@ def init_cache(config: TransformerConfig, batch: int,
     max_len = max_len or config.max_seq_len
     shape = (config.n_layers, batch, config.n_kv_heads, max_len,
              config.head_dim)
+    if config.kv_dtype == "int8":
+        scale_shape = shape[:-1] + (1,)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(scale_shape, jnp.float32),
+                "v": jnp.zeros(shape, jnp.int8),
+                "v_scale": jnp.zeros(scale_shape, jnp.float32)}
     return {"k": jnp.zeros(shape, config.jnp_dtype),
             "v": jnp.zeros(shape, config.jnp_dtype)}
 
 
-def cache_specs(sequence_parallel: bool = False) -> dict:
+def cache_specs(sequence_parallel: bool = False,
+                quantized: bool = False) -> dict:
     """Cache layout (layers, batch, kv_heads, len, head_dim): batch on
     "data", heads on "model" (TP); with sequence_parallel the cache LENGTH
     also shards over "seq", so long-context decode spreads KV bandwidth
-    across the mesh (sp_decode_attention)."""
+    across the mesh (sp_decode_attention).  quantized=True adds the int8
+    cache's per-position scale planes (same layout, head_dim collapsed)."""
     seq = "seq" if sequence_parallel else None
     spec = P(None, "data", "model", seq, None)
+    if quantized:
+        return {"k": spec, "k_scale": spec, "v": spec, "v_scale": spec}
     return {"k": spec, "v": spec}
+
+
+def _quantize_kv(x):
+    """(B, H, L, D) float -> (int8 codes, f32 scale (B, H, L, 1)):
+    symmetric per-(batch, head, position) absmax scaling over head_dim.
+    One rounding per written token; dequantization is codes * scale."""
+    as_f32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(as_f32), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    codes = jnp.clip(jnp.round(as_f32 / scale), -127, 127).astype(jnp.int8)
+    return codes, scale
 
 
 # -- forward ----------------------------------------------------------------
 
 def _attention(config: TransformerConfig, layer, h, cos, sin,
-               cache_k=None, cache_v=None, pos=None):
-    """Returns (output, new_cache_k, new_cache_v).  Without a cache:
-    flash-attention causal prefill.  With a cache: write new K/V at `pos`,
-    masked attention over the whole cache buffer."""
+               cache_k=None, cache_v=None, pos=None,
+               cache_k_scale=None, cache_v_scale=None):
+    """Returns (output, new_k, new_v, new_k_scale, new_v_scale) -- the
+    scale entries are None unless the cache is int8-quantized.  Without
+    a cache: flash-attention causal prefill.  With a cache: write new
+    K/V at `pos` (quantizing when the cache is int8), masked attention
+    over the whole cache buffer."""
     batch, length, _ = h.shape
     hd = config.head_dim
     q = dense(layer["wq"], h).reshape(
@@ -237,6 +277,14 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
             out = flash_attention(q, repeat_kv(k, repeats),
                                   repeat_kv(v, repeats), causal=True)
     else:
+        quantized = cache_k.dtype == jnp.int8
+        if quantized:
+            k, k_scale = _quantize_kv(k)
+            v, v_scale = _quantize_kv(v)
+            cache_k_scale = jax.lax.dynamic_update_slice(
+                cache_k_scale, k_scale, (0, 0, pos, 0))
+            cache_v_scale = jax.lax.dynamic_update_slice(
+                cache_v_scale, v_scale, (0, 0, pos, 0))
         cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
         if config.sequence_parallel and length > 1:
@@ -260,8 +308,18 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
             # merge with a pmax/psum online-softmax
             out = sp_decode_attention(q, cache_k, cache_v, pos)
         else:
-            k_full = repeat_kv(cache_k, repeats)
-            v_full = repeat_kv(cache_v, repeats)
+            if quantized:
+                # dequantize into the einsum operand load (int8 codes x
+                # per-position scale); the cache READ stays 8-bit, which
+                # is the bandwidth that bounds decode
+                k_eff = (cache_k.astype(jnp.float32)
+                         * cache_k_scale).astype(q.dtype)
+                v_eff = (cache_v.astype(jnp.float32)
+                         * cache_v_scale).astype(q.dtype)
+            else:
+                k_eff, v_eff = cache_k, cache_v
+            k_full = repeat_kv(k_eff, repeats)
+            v_full = repeat_kv(v_eff, repeats)
             scale = 1.0 / jnp.sqrt(jnp.float32(hd))
             logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_full,
                                 preferred_element_type=jnp.float32) * scale
@@ -273,7 +331,8 @@ def _attention(config: TransformerConfig, layer, h, cos, sin,
             out = jnp.einsum("bhqk,bhkd->bhqd",
                              weights.astype(v_full.dtype), v_full)
     out = out.transpose(0, 2, 1, 3).reshape(batch, length, -1)
-    return dense(layer["wo"], out), cache_k, cache_v
+    return (dense(layer["wo"], out), cache_k, cache_v,
+            cache_k_scale, cache_v_scale)
 
 
 def _router(config: TransformerConfig, layer, x):
@@ -429,11 +488,15 @@ def forward(params: dict, config: TransformerConfig, tokens,
     def layer_step(carry, xs):
         h, aux_sum = carry
         layer, layer_cache = xs
-        attn_out, new_k, new_v = _attention(
+        attn_out, new_k, new_v, new_k_scale, new_v_scale = _attention(
             config, layer, rms_norm(layer["attn_norm"], h, config.norm_eps),
             cos, sin,
             cache_k=None if layer_cache is None else layer_cache["k"],
             cache_v=None if layer_cache is None else layer_cache["v"],
+            cache_k_scale=(None if layer_cache is None
+                           else layer_cache.get("k_scale")),
+            cache_v_scale=(None if layer_cache is None
+                           else layer_cache.get("v_scale")),
             pos=pos)
         h = h + attn_out
         mlp_in = rms_norm(layer["mlp_norm"], h, config.norm_eps)
@@ -448,8 +511,13 @@ def forward(params: dict, config: TransformerConfig, tokens,
         h = h + mlp_out
         if activation_specs:
             h = jax.lax.with_sharding_constraint(h, act_spec)
-        new_cache = (None if new_k is None
-                     else {"k": new_k, "v": new_v})
+        if new_k is None:
+            new_cache = None
+        elif new_k_scale is not None:
+            new_cache = {"k": new_k, "k_scale": new_k_scale,
+                         "v": new_v, "v_scale": new_v_scale}
+        else:
+            new_cache = {"k": new_k, "v": new_v}
         return (h, aux_sum), new_cache
 
     aux0 = jnp.zeros((), jnp.float32)

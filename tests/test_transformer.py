@@ -637,3 +637,224 @@ class TestLlama8BFeasibility:
         _, _, outputs = responses.get(timeout=300)
         assert "generated" in outputs and "text" in outputs
         process.terminate()
+
+
+class TestLlama8BRealDimsLowering:
+    """VERDICT r4 item 5: the TRUE-dims Llama-3-8B (4096 d_model, 32
+    layers, 32/8 GQA heads, 128k vocab, untied head) decode and prefill
+    programs must LOWER AND COMPILE over the 8-device serving mesh with
+    megatron-bounded collectives -- proven from ABSTRACT inputs
+    (ShapeDtypeStruct + NamedSharding; zero weight bytes materialize),
+    completing the eval_shape HBM-budget proof with a program-level
+    artifact.  Reference seat: BASELINE config 4 / the reference's LLM
+    element (examples/llm/elements_llm.py:137)."""
+
+    BATCH = 8
+
+    def _mesh(self):
+        from aiko_services_tpu.parallel.mesh import create_mesh
+        # the serving mesh from examples/pipeline_llm_8b.json
+        return create_mesh({"data": 1, "fsdp": 2, "seq": 1, "model": 4})
+
+    def _abstract(self, shapes, specs_tree, mesh):
+        import jax
+        flat_shapes, treedef = jax.tree_util.tree_flatten(shapes)
+        flat_specs, _ = jax.tree_util.tree_flatten(
+            specs_tree, is_leaf=lambda x: isinstance(
+                x, jax.sharding.PartitionSpec))
+        assert len(flat_shapes) == len(flat_specs)
+        return treedef.unflatten([
+            jax.ShapeDtypeStruct(
+                struct.shape, struct.dtype,
+                sharding=jax.sharding.NamedSharding(mesh, spec))
+            for struct, spec in zip(flat_shapes, flat_specs)])
+
+    def _structs(self, mesh, max_len):
+        import jax
+        from aiko_services_tpu.models import (
+            cache_specs, init_cache, init_params, param_specs)
+        from aiko_services_tpu.models.configs import LLAMA3_8B
+        from aiko_services_tpu.parallel import filter_specs
+
+        config = LLAMA3_8B
+        param_shapes = jax.eval_shape(
+            lambda: init_params(config, jax.random.PRNGKey(0)))
+        specs = filter_specs(
+            param_specs(config, lm_head="lm_head" in param_shapes), mesh)
+        specs = {key: specs[key] for key in param_shapes}
+        params = self._abstract(param_shapes, specs, mesh)
+        cache_shapes = jax.eval_shape(
+            lambda: init_cache(config, self.BATCH, max_len=max_len))
+        cache = self._abstract(
+            cache_shapes, filter_specs(cache_specs(), mesh), mesh)
+        return config, params, cache
+
+    def _collectives(self, hlo):
+        import re
+        found = re.findall(
+            r"= \S+ (all-reduce|all-gather|reduce-scatter|all-to-all|"
+            r"collective-permute)\(", hlo)
+        counts = {}
+        for kind in found:
+            counts[kind] = counts.get(kind, 0) + 1
+        return found, counts
+
+    def test_8b_decode_step_compiles_at_true_dims(self):
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+
+        from aiko_services_tpu.models import decode_step
+
+        mesh = self._mesh()
+        config, params, cache = self._structs(mesh, max_len=8192)
+        replicated = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())
+        token = jax.ShapeDtypeStruct((self.BATCH, 1), jnp.int32,
+                                     sharding=replicated)
+        pos = jax.ShapeDtypeStruct((), jnp.int32, sharding=replicated)
+        with jax.set_mesh(mesh):
+            step = jax.jit(partial(decode_step, config=config))
+            hlo = step.lower(params, cache=cache, token=token,
+                             pos=pos).compile().as_text()
+        found, counts = self._collectives(hlo)
+        print(f"8B decode step collectives over {dict(data=1, fsdp=2, seq=1, model=4)}: "
+              f"{counts}")
+        budget = 2 * config.n_layers + 2
+        assert 1 <= len(found) <= budget, (
+            f"{len(found)} collectives per 8B decode step "
+            f"(budget {budget}): {counts}")
+
+    def test_8b_prefill_compiles_at_true_dims(self):
+        import jax
+        import jax.numpy as jnp
+
+        from aiko_services_tpu.models import forward
+
+        mesh = self._mesh()
+        config, params, cache = self._structs(mesh, max_len=8192)
+        replicated = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())
+        tokens = jax.ShapeDtypeStruct((self.BATCH, 512), jnp.int32,
+                                      sharding=replicated)
+        with jax.set_mesh(mesh):
+            prefill = jax.jit(
+                lambda p, t, c: forward(p, config, t, cache=c, pos=0))
+            hlo = prefill.lower(params, tokens, cache).compile().as_text()
+        found, counts = self._collectives(hlo)
+        print(f"8B prefill (512 tokens) collectives: {counts}")
+        assert found, "sharded 8B prefill must lower with collectives"
+
+
+class TestKVCacheInt8:
+    """VERDICT r4 item 4: int8 KV cache -- halves cache HBM (doubling
+    feasible decode batch) with numerics pinned against the
+    full-precision cache."""
+
+    def _config(self):
+        from dataclasses import replace
+        from aiko_services_tpu.models.configs import LLAMA32_1B
+        return replace(
+            LLAMA32_1B, vocab_size=256, d_model=64, n_layers=2,
+            n_heads=8, n_kv_heads=2, d_ff=128, max_seq_len=128,
+            dtype="float32")
+
+    def test_int8_cache_halves_bytes(self):
+        import jax
+        from dataclasses import replace
+        from aiko_services_tpu.models import init_cache
+
+        config = self._config()
+
+        def nbytes(cache):
+            return sum(leaf.size * leaf.dtype.itemsize
+                       for leaf in jax.tree_util.tree_leaves(cache))
+
+        dense_bytes = nbytes(init_cache(config, 4, max_len=64))
+        quant_bytes = nbytes(init_cache(
+            replace(config, kv_dtype="int8"), 4, max_len=64))
+        # int8 codes (1/4 of f32) + f32 scale per position (1/head_dim)
+        assert quant_bytes < dense_bytes * 0.5
+
+    def test_int8_cache_generation_matches_full_precision(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from dataclasses import replace
+        from aiko_services_tpu.models import generate, init_params
+
+        config = self._config()
+        params = init_params(config, jax.random.PRNGKey(3))
+        prompt = jnp.asarray(
+            np.random.default_rng(1).integers(3, 250, (4, 12)), jnp.int32)
+        tokens_fp, _ = generate(params, config, prompt, 12)
+        tokens_q, _ = generate(
+            params, replace(config, kv_dtype="int8"), prompt, 12)
+        np.testing.assert_array_equal(np.asarray(tokens_fp),
+                                      np.asarray(tokens_q))
+
+    def test_int8_decode_logits_drift_pinned(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from dataclasses import replace
+        from aiko_services_tpu.models import (
+            decode_step, forward, init_cache, init_params)
+
+        config = self._config()
+        config_q = replace(config, kv_dtype="int8")
+        params = init_params(config, jax.random.PRNGKey(5))
+        prompt = jnp.asarray(
+            np.random.default_rng(2).integers(3, 250, (2, 16)), jnp.int32)
+        caches = {}
+        logits = {}
+        for name, cfg in (("fp", config), ("q", config_q)):
+            cache = init_cache(cfg, 2, max_len=32)
+            _, cache = forward(params, cfg, prompt, cache=cache, pos=0)
+            token = jnp.full((2, 1), 7, jnp.int32)
+            _, step_logits, cache = decode_step(
+                params, cfg, cache, token, jnp.int32(16))
+            caches[name], logits[name] = cache, np.asarray(step_logits)
+        drift = np.max(np.abs(logits["q"] - logits["fp"]))
+        span = np.max(np.abs(logits["fp"])) + 1e-9
+        assert drift / span < 0.02, f"relative drift {drift / span:.4f}"
+
+    def test_int8_rejects_sequence_parallel(self):
+        import pytest
+        from dataclasses import replace
+        with pytest.raises(ValueError, match="sequence-parallel"):
+            replace(self._config(), kv_dtype="int8",
+                    sequence_parallel=True)
+
+    def test_int8_sharded_decode_matches_unsharded(self):
+        """cache_specs(quantized=True) lays the int8 cache (codes +
+        scale planes) onto the serving mesh: sharded decode must equal
+        the single-device int8 path -- the batch-headroom use case the
+        quantized cache exists for."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from dataclasses import replace
+        from aiko_services_tpu.models import (
+            cache_specs, generate, init_cache, init_params, param_specs)
+        from aiko_services_tpu.parallel import filter_specs, shard_pytree
+        from aiko_services_tpu.parallel.mesh import create_mesh
+
+        config = replace(self._config(), kv_dtype="int8")
+        params = init_params(config, jax.random.PRNGKey(7))
+        prompt = jnp.asarray(
+            np.random.default_rng(4).integers(3, 250, (4, 12)), jnp.int32)
+        dense_tokens, _ = generate(params, config, prompt, 8)
+
+        mesh = create_mesh({"data": 2, "fsdp": 2, "seq": 1, "model": 2})
+        sharded_params = shard_pytree(
+            params, mesh, filter_specs(param_specs(config), mesh))
+        cache = shard_pytree(
+            init_cache(config, 4, max_len=32), mesh,
+            filter_specs(cache_specs(quantized=True), mesh))
+        with jax.set_mesh(mesh):
+            sharded_tokens, _ = generate(
+                sharded_params, config, prompt, 8, cache=cache)
+        np.testing.assert_array_equal(np.asarray(dense_tokens),
+                                      np.asarray(sharded_tokens))
