@@ -160,9 +160,10 @@ def _run_pipeline(definition, warmup: int, measure: int,
     pipeline = create_pipeline(process, definition)
     process.run(in_thread=True)
     responses = queue.Queue()
+    window = int(os.environ.get("AIKO_BENCH_WINDOW", "64"))
     pipeline.create_stream("bench", queue_response=responses,
                            grace_time=1800,
-                           parameters={"frame_window": 32})
+                           parameters={"frame_window": window})
     for _ in range(warmup):
         _, _, outputs = responses.get(timeout=timeout)
     if warmup:
@@ -391,6 +392,25 @@ def bench_llm(peak):
                                         max_new, chunk=chunk):
             scale_produced += block.shape[1]
         scaling[f"batch_{scale_batch}"] = round(
+            scale_produced * scale_batch
+            / (time.perf_counter() - scale_start), 1)
+
+    # int8 KV cache (kv_dtype="int8"): halved cache HBM and cache-read
+    # bandwidth, doubling the feasible decode batch at fixed memory;
+    # numerics pinned in tests/test_transformer.py::TestKVCacheInt8
+    from dataclasses import replace
+    config_q = replace(config, kv_dtype="int8")
+    for scale_batch in ((2,) if SMOKE else (128,)):
+        scale_prompt = jnp.ones((scale_batch, prompt_len), jnp.int32)
+        for _ in generate_stream(params, config_q, scale_prompt, max_new,
+                                 chunk=chunk):
+            pass  # compile at this batch
+        scale_start = time.perf_counter()
+        scale_produced = 0
+        for _, block in generate_stream(params, config_q, scale_prompt,
+                                        max_new, chunk=chunk):
+            scale_produced += block.shape[1]
+        scaling[f"batch_{scale_batch}_kv_int8"] = round(
             scale_produced * scale_batch
             / (time.perf_counter() - scale_start), 1)
     return {"model": f"{name} ({n_params / 1e6:.0f}M params)",
@@ -631,13 +651,13 @@ def bench_multimodal(peak):
     # 5 s chunks = the reference speech cadence (audio_io.py:455-460)
     audio_seconds = 1.0 if SMOKE else 5.0
     # rows per frame (data_batch_size) x frames coalesced per jit call;
-    # env-tunable for scaling experiments
-    # rows=16 measured best on v5e: decode is weight-streaming-bound, so
-    # rows are nearly free until compile time / latency push back
-    # (rows 4 -> 8 -> 16: MFU 0.036 -> 0.152 -> 0.239; rows 32 exploded
-    # compile time)
+    # env-tunable for scaling experiments.  Measured on v5e round 5
+    # (after the jitted coalesce program landed): rows 16 / micro 8 /
+    # window 64 -> 18.95 fps, MFU 0.263; micro 4 -> 10.7 fps / 0.149;
+    # rows 24 collapsed to 3.2 fps (compile-bound) and micro 16
+    # (batch-256 stages) stalled the 900 s response timeout compiling
     batch = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_ROWS", "16"))
-    micro = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_MICRO", "4"))
+    micro = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_MICRO", "8"))
     max_tokens = 16
     # the LM stage DECODES (greedy, one jit: prefill + fori_loop), the
     # reference's chat semantics (elements_llm.py:181-210) -- not a
@@ -809,21 +829,42 @@ def bench_serving(peak):
         process.terminate()
         return total / elapsed
 
+    import numpy as np
+
     micro = 4 if SMOKE else 16
-    fps_coalesced = run(micro)
-    fps_single = run(1)
+    # the round-4 A/B was ONE trial per arm, coalesced first -- and the
+    # driver's run recorded the opposite conclusion from the builder's
+    # (speedup 1.95 claimed, 0.37 recorded).  Interleaved repeated
+    # trials with ALTERNATING order make order effects and tunnel
+    # variance visible as spread instead of silently deciding the
+    # verdict; medians decide the speedup
+    trials = 1 if SMOKE else 3
+    fps_coalesced, fps_single = [], []
+    for trial in range(trials):
+        arms = [(micro, fps_coalesced), (1, fps_single)]
+        if trial % 2:
+            arms.reverse()
+        for arm_micro, sink in arms:
+            sink.append(run(arm_micro))
+    med_coalesced = float(np.median(fps_coalesced))
+    med_single = float(np.median(fps_single))
     flops = detector_flops_per_image(config)
     return {
         "streams": streams_n,
-        "frames_per_sec_total": round(fps_coalesced, 1),
-        "frames_per_sec_uncoalesced": round(fps_single, 1),
-        "coalescing_speedup": round(fps_coalesced / max(fps_single, 1e-9),
-                                    2),
+        "frames_per_sec_total": round(med_coalesced, 1),
+        "coalesced_spread": [round(min(fps_coalesced), 1),
+                             round(max(fps_coalesced), 1)],
+        "frames_per_sec_uncoalesced": round(med_single, 1),
+        "uncoalesced_spread": [round(min(fps_single), 1),
+                               round(max(fps_single), 1)],
+        "coalescing_speedup": round(
+            med_coalesced / max(med_single, 1e-9), 2),
+        "trials_per_arm": trials,
         "micro_batch": micro,
         "model": f"{preset} {size}x{size}",
         "vs_reference_broker_ceiling": round(
-            fps_coalesced / REFERENCE_FRAMES_PER_SEC, 1),
-        "mfu": _mfu(fps_coalesced * flops, peak),
+            med_coalesced / REFERENCE_FRAMES_PER_SEC, 1),
+        "mfu": _mfu(med_coalesced * flops, peak),
     }
 
 
@@ -872,6 +913,58 @@ def bench_tts(peak):
             "speech_sec_per_sec": round(fps * batch * seconds, 1),
             "batch": batch,
             "mfu": _mfu(fps * flops, peak)}
+
+
+# Hard cap on the FINAL printed line.  The driver records only the last
+# ~2000 chars of bench output; round 4's single fat JSON line outgrew
+# that window and the headline metric was lost ("parsed": null in
+# BENCH_r04.json).  The final line must always fit with margin.
+HEADLINE_LINE_CAP = 1200
+
+# one representative scalar per config for the compact summary:
+# config name -> (field in that config's dict, short key in summary)
+_SUMMARY_FIELDS = (
+    ("asr", "mfu", "asr_mfu"),
+    ("detector", "mfu", "det_mfu"),
+    ("llm", "tokens_per_sec", "llm_tok_s"),
+    ("llm", "decode_mfu", "llm_mfu"),
+    ("train", "train_mfu", "train_mfu"),
+    ("serving", "coalescing_speedup", "serving_speedup"),
+    ("serving", "frames_per_sec_total", "serving_fps"),
+    ("tts", "mfu", "tts_mfu"),
+    ("pipeline_multimodal", "mfu", "headline_mfu"),
+    ("pipeline_multimodal", "audio_realtime_factor", "audio_rt"),
+)
+
+
+def compact_headline(detail: dict, cap: int = HEADLINE_LINE_CAP) -> str:
+    """The short FINAL output line: headline metric + vs_baseline + a
+    one-scalar-per-config summary, guaranteed to parse and to fit in
+    `cap` chars (tested in tests/test_bench_output.py).  Full per-config
+    detail lives in BENCH_DETAIL.json / the earlier detail line."""
+    compact = {key: value for key, value in detail.items()
+               if key != "configs"}
+    configs = detail.get("configs", {})
+    summary = {}
+    for config_name, field, short in _SUMMARY_FIELDS:
+        value = configs.get(config_name, {}).get(field)
+        if value is not None:
+            summary[short] = value
+    compact["summary"] = summary
+    compact["detail_file"] = "BENCH_DETAIL.json"
+    # progressive field drops keep the guarantee even if units/summary
+    # grow; never drop metric/value/vs_baseline
+    for drop in (None, "summary", "baseline", "unit",
+                 "peak_tflops_assumed", "device_fallback"):
+        if drop is not None:
+            compact.pop(drop, None)
+        line = json.dumps(compact)
+        if len(line) <= cap:
+            break
+    parsed = json.loads(line)  # parse guard: the line IS the record
+    assert len(line) <= cap and "vs_baseline" in parsed, (
+        f"headline line {len(line)} chars exceeds cap {cap}")
+    return line
 
 
 def _accelerator_failure(timeout: float = 120.0) -> str | None:
@@ -946,6 +1039,7 @@ def main() -> None:
         first = next(iter(configs.values()))
         headline_fps = (first.get("frames_per_sec_chip")
                         or first.get("frames_per_sec")
+                        or first.get("frames_per_sec_total")
                         or first.get("tokens_per_sec", 0.0))
         headline_p50 = first.get("p50_ms", 0.0) / 1000.0
 
@@ -975,7 +1069,18 @@ def main() -> None:
     }
     if device_fallback:
         result["device_fallback"] = device_fallback
-    print(json.dumps(result))
+    # full detail: a file (committed evidence) + an earlier output line;
+    # the FINAL line is compact so the driver's ~2000-char tail window
+    # always contains it whole (round-4 lesson: BENCH_r04 parsed null)
+    detail_line = json.dumps(result)
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json"), "w") as handle:
+            handle.write(detail_line + "\n")
+    except OSError:
+        pass  # read-only checkout: the detail line below still records it
+    print(detail_line)
+    print(compact_headline(result))
     sys.stdout.flush()
     # hard-exit: skip interpreter teardown -- the tunneled device client's
     # background threads can raise during destructor-time shutdown
