@@ -113,54 +113,144 @@ def synthesize_mel(params: dict, config: TTSConfig, chars) -> jnp.ndarray:
 
 
 def _frame(signal, n_fft: int, hop: int):
-    """(B, S) -> (B, frames, n_fft) strided windows via gather (XLA turns
-    the static index matrix into an efficient slice pattern)."""
+    """(B, S) -> (B, frames, n_fft) strided windows.  When hop divides
+    n_fft (the config default: 400/100) the frames assemble from STATIC
+    slices of hop-sized blocks -- TPU gathers are serial and this
+    framing sits inside the Griffin-Lim loop; the gather fallback
+    covers exotic hop settings."""
     frames = 1 + (signal.shape[-1] - n_fft) // hop
+    if n_fft % hop == 0:
+        ratio = n_fft // hop
+        usable = frames + ratio - 1          # hop-blocks covering frames
+        blocks = signal[:, :usable * hop].reshape(
+            signal.shape[0], usable, hop)
+        return jnp.concatenate(
+            [blocks[:, s:s + frames] for s in range(ratio)],
+            axis=2)
     index = (jnp.arange(frames)[:, None] * hop
              + jnp.arange(n_fft)[None, :])
     return signal[:, index]
 
 
-def _stft(signal, n_fft: int, hop: int, window):
-    return jnp.fft.rfft(_frame(signal, n_fft, hop) * window, axis=-1)
+def _dft_matrices(n_fft: int):
+    """rfft as a pair of real matmuls: the shared cos/-sin bases
+    (ops/audio.py dft_basis -- same math as the ASR conv-STFT kernel).
+    TPU-first: a 400x201 matmul rides the MXU while XLA's complex FFT
+    at this size runs on the scalar/vector pipeline -- the Griffin-Lim
+    loop is 2 transforms x 30 iterations deep, so the transform IS the
+    workload (bench note: tts section, BENCH_NOTES.md)."""
+    from ..ops.audio import dft_basis
+    cos_m, sin_m = dft_basis(n_fft)
+    return jnp.asarray(cos_m), jnp.asarray(sin_m)
 
 
-def _istft(spec, n_fft: int, hop: int, window, length: int):
-    frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) * window
+def _irfft_weights(n_fft: int):
+    """Hermitian bin weights for the real inverse: DC and Nyquist count
+    once, interior bins twice (their conjugate halves are implicit)."""
+    bins = n_fft // 2 + 1
+    weights = np.full((bins,), 2.0, np.float32)
+    weights[0] = 1.0
+    if n_fft % 2 == 0:
+        weights[-1] = 1.0
+    return jnp.asarray(weights / n_fft, jnp.float32)
+
+
+def _stft_ri(signal, n_fft: int, hop: int, window, cos_m, sin_m):
+    """(B, S) -> (real, imag) each (B, frames, bins), via MXU matmuls.
+    Precision.HIGHEST: the default TPU matmul precision loses ~3
+    decimal digits on the DFT's cancellation-heavy sums (measured in
+    ops/audio.py), and Griffin-Lim feeds each iteration's error into
+    the next."""
+    frames = _frame(signal, n_fft, hop) * window
+    highest = jax.lax.Precision.HIGHEST
+    return (jnp.matmul(frames, cos_m, precision=highest),
+            jnp.matmul(frames, sin_m, precision=highest))
+
+
+def _window_norm(window_np: np.ndarray, hop: int, n_frames: int,
+                 length: int):
+    """Overlap-add normalization for the GIVEN window: depends only on
+    the window and the shapes, so it is a numpy-built constant, never
+    device work."""
+    n_fft = window_np.shape[0]
+    window_sq = np.asarray(window_np, np.float32) ** 2
+    total = np.zeros((length,), np.float32)
+    for frame in range(n_frames):
+        total[frame * hop:frame * hop + n_fft] += window_sq
+    return jnp.asarray(np.maximum(total, 1e-8))
+
+
+def _overlap_add(frames, n_fft: int, hop: int, length: int):
+    """(B, F, n_fft) windowed frames -> (B, length) sum at hop offsets.
+    When hop divides n_fft this is `ratio` STATIC-slice adds on a
+    hop-blocked accumulator (the scatter fallback is the single
+    slowest op a TPU can run, and it sat inside the Griffin-Lim
+    loop: 30 x ~4 ms/iteration was the whole TTS budget)."""
     batch, n_frames, _ = frames.shape
+    if n_fft % hop == 0:
+        ratio = n_fft // hop
+        blocks = frames.reshape(batch, n_frames, ratio, hop)
+        acc = jnp.zeros((batch, n_frames + ratio - 1, hop),
+                        frames.dtype)
+        for s in range(ratio):
+            acc = acc.at[:, s:s + n_frames].add(blocks[:, :, s])
+        return acc.reshape(batch, -1)[:, :length]
     signal = jnp.zeros((batch, length), frames.dtype)
-    window_sum = jnp.zeros((length,), frames.dtype)
     positions = (jnp.arange(n_frames)[:, None] * hop
                  + jnp.arange(n_fft)[None, :])       # (frames, n_fft)
-    flat = positions.reshape(-1)
-    signal = signal.at[:, flat].add(
+    return signal.at[:, positions.reshape(-1)].add(
         frames.reshape(batch, -1))
-    window_sum = window_sum.at[flat].add(
-        jnp.tile(window * window, (n_frames, 1)).reshape(-1))
-    return signal / jnp.maximum(window_sum, 1e-8)[None, :]
+
+
+def _istft_ri(real, imag, n_fft: int, hop: int, window, length: int,
+              cos_m, sin_m, weights, norm):
+    """Inverse of _stft_ri + windowed overlap-add against the
+    precomputed window normalization (`norm` from _window_norm -- it is
+    loop-invariant, built once per griffin_lim call, and MUST match the
+    `window` actually applied here).  x[n] = sum_k w_k (real_k cos -
+    imag_k sin(angle)) -- two HIGHEST-precision matmuls against the
+    transposed bases (see _stft_ri)."""
+    highest = jax.lax.Precision.HIGHEST
+    frames = (jnp.matmul(real * weights, cos_m.T, precision=highest)
+              + jnp.matmul(imag * weights, sin_m.T,
+                           precision=highest)) * window
+    signal = _overlap_add(frames, n_fft, hop, length)
+    return signal / norm[None, :]
 
 
 def griffin_lim(magnitude, config: TTSConfig) -> jnp.ndarray:
     """Phase recovery: magnitude (B, n_fft//2+1, T) -> waveform (B, S).
 
     Classic Griffin-Lim as a lax.fori_loop of ISTFT/STFT round-trips --
-    fully on-device, jit-compiled with the synthesis net."""
+    fully on-device, jit-compiled with the synthesis net.  The
+    transforms run as real DFT matmuls (MXU) rather than complex FFTs,
+    and the loop carries only the phase ANGLE (real), so no complex
+    dtype exists anywhere (speedup vs the jnp.fft formulation measured
+    in BENCH_NOTES.md, tts section)."""
     n_fft, hop = config.n_fft, config.hop
     magnitude = magnitude.transpose(0, 2, 1)            # (B, T, bins)
     frames = magnitude.shape[1]
     length = (frames - 1) * hop + n_fft
-    window = jnp.hanning(n_fft).astype(jnp.float32)
+    window_np = np.hanning(n_fft).astype(np.float32)
+    window = jnp.asarray(window_np)
+    cos_m, sin_m = _dft_matrices(n_fft)
+    weights = _irfft_weights(n_fft)
+    norm = _window_norm(window_np, hop, frames, length)
     angles = jnp.zeros_like(magnitude)                  # deterministic
 
     def body(_, angles):
-        signal = _istft(magnitude * jnp.exp(1j * angles), n_fft, hop,
-                        window, length)
-        rebuilt = _stft(signal, n_fft, hop, window)
-        return jnp.angle(rebuilt)
+        signal = _istft_ri(magnitude * jnp.cos(angles),
+                           magnitude * jnp.sin(angles),
+                           n_fft, hop, window, length,
+                           cos_m, sin_m, weights, norm)
+        real, imag = _stft_ri(signal, n_fft, hop, window, cos_m, sin_m)
+        return jnp.arctan2(imag, real)
 
     angles = jax.lax.fori_loop(0, config.griffin_lim_iters, body, angles)
-    return _istft(magnitude * jnp.exp(1j * angles), n_fft, hop, window,
-                  length)
+    return _istft_ri(magnitude * jnp.cos(angles),
+                     magnitude * jnp.sin(angles),
+                     n_fft, hop, window, length, cos_m, sin_m, weights,
+                     norm)
 
 
 def make_tts_train_step(config: TTSConfig, optimizer):

@@ -35,6 +35,21 @@ def _mel_to_hz(mel):
 
 
 @functools.lru_cache(maxsize=8)
+def dft_basis(n_fft: int) -> tuple:
+    """rfft as real matmul bases: (cos, -sin), each (n_fft, bins) --
+    the rfft convention e^{-i angle}.  Shared by the ASR conv-STFT
+    kernel and the TTS Griffin-Lim transforms (models/tts.py).
+    CAUTION: run these through matmul/conv with Precision.HIGHEST --
+    the default TPU precision loses ~3 decimal digits on the DFT's
+    cancellation-heavy sums (measured in log_mel_spectrogram)."""
+    n_freqs = n_fft // 2 + 1
+    angles = (2.0 * np.pi / n_fft) * np.outer(np.arange(n_fft),
+                                              np.arange(n_freqs))
+    return (np.cos(angles).astype(np.float32),
+            (-np.sin(angles)).astype(np.float32))
+
+
+@functools.lru_cache(maxsize=8)
 def _stft_kernel(n_fft: int) -> np.ndarray:
     """Windowed real-DFT basis as a conv kernel (n_fft, 1, n_fft+2):
     the whole STFT becomes ONE strided convolution.
@@ -45,12 +60,9 @@ def _stft_kernel(n_fft: int) -> np.ndarray:
     hop_length and 2*(n_fft//2+1) output channels (cos|sin per frequency)
     does framing, windowing, and the DFT in one MXU-native op: ~2.6 GFLOP
     for 16x5 s of audio (measured: 29 ms via rfft+gather -> sub-ms)."""
-    n_freqs = n_fft // 2 + 1
-    angles = (2.0 * np.pi / n_fft) * np.outer(np.arange(n_fft),
-                                              np.arange(n_freqs))
+    cos_m, sin_m = dft_basis(n_fft)
     window = np.hanning(n_fft).astype(np.float32)[:, None]
-    basis = np.concatenate([np.cos(angles), -np.sin(angles)],
-                           axis=1).astype(np.float32)
+    basis = np.concatenate([cos_m, sin_m], axis=1)
     return (window * basis)[:, None, :]            # (W, I=1, O=2*n_freqs)
 
 

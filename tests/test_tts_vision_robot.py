@@ -441,3 +441,31 @@ def test_robot_camera_frames_flow_into_pipeline():
     assert int(robot.share["camera_frames"]) >= 3
     assert robot.share["camera"] == "off"
     process.terminate()
+
+
+def test_dft_matmul_matches_jnp_fft():
+    """The Griffin-Lim transforms run as real DFT matmuls (MXU) -- they
+    must agree with the jnp.fft reference they replaced."""
+    import jax.numpy as jnp
+    import numpy as np
+    from aiko_services_tpu.models.tts import (
+        _dft_matrices, _frame, _irfft_weights, _stft_ri)
+
+    n_fft, hop = 400, 100
+    rng = np.random.default_rng(0)
+    signal = jnp.asarray(rng.standard_normal((2, 2000)), jnp.float32)
+    window = jnp.hanning(n_fft).astype(jnp.float32)
+    cos_m, sin_m = _dft_matrices(n_fft)
+
+    real, imag = _stft_ri(signal, n_fft, hop, window, cos_m, sin_m)
+    reference = jnp.fft.rfft(_frame(signal, n_fft, hop) * window, axis=-1)
+    np.testing.assert_allclose(np.asarray(real), np.asarray(reference.real),
+                               atol=2e-3)
+    np.testing.assert_allclose(np.asarray(imag), np.asarray(reference.imag),
+                               atol=2e-3)
+
+    # inverse: weighted matmul reconstructs the framed signal
+    weights = _irfft_weights(n_fft)
+    frames = ((real * weights) @ cos_m.T + (imag * weights) @ sin_m.T)
+    expected = np.asarray(jnp.fft.irfft(reference, n=n_fft, axis=-1))
+    np.testing.assert_allclose(np.asarray(frames), expected, atol=1e-4)
