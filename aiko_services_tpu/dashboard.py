@@ -15,7 +15,7 @@ import time
 from .runtime import ECConsumer, Process
 from .runtime.service import ServiceFilter
 from .runtime.share import ServicesCache
-from .utils import generate, get_logger
+from .utils import generate, get_logger, parse
 
 __all__ = ["DashboardModel", "run_dashboard", "render_snapshot",
            "register_plugin", "plugin_for"]
@@ -62,6 +62,8 @@ class DashboardModel:
         self._consumer: ECConsumer | None = None
         self.log_lines: list = []
         self._log_topic = None
+        self.history_lines: list = []
+        self._history_topic = None
 
     def _service_event(self, command, fields) -> None:
         # copy-on-write: the curses thread iterates self.rows concurrently
@@ -110,6 +112,43 @@ class DashboardModel:
             self.process.publish(f"{self.selected}/in",
                                  generate("terminate", []))
 
+    # -- registrar history page (reference dashboard.py:565-648) ------------
+
+    def request_history(self, count: int = 20) -> None:
+        """Ask the selected service for its event history ring (the
+        registrar's `(history response_topic count)` actor command,
+        runtime/registrar.py:155).  Each request gets its OWN response
+        topic (a per-request sequence number): over a real broker,
+        still-in-flight replies from a previous request land on the
+        retired topic -- no handler -- instead of interleaving into the
+        new page."""
+        if not self.selected:
+            return
+        self.history_lines = []
+        if self._history_topic is not None:
+            self.process.remove_message_handler(
+                self._history_handler, self._history_topic)
+        self._history_seq = getattr(self, "_history_seq", 0) + 1
+        self._history_topic = (
+            f"{self.process.topic_path_process}/0/dashboard/history/"
+            f"{self._history_seq}")
+        self.process.add_message_handler(
+            self._history_handler, self._history_topic)
+        self.process.publish(
+            f"{self.selected}/in",
+            generate("history", [self._history_topic, str(count)]))
+
+    def _history_handler(self, topic, payload) -> None:
+        try:
+            command, parameters = parse(str(payload))
+        except ValueError:
+            return
+        if command == "history" and len(parameters) >= 4:
+            event, timestamp, topic_path, name = parameters[:4]
+            self.history_lines.append(
+                f"{event:8} {str(name):18.18} {topic_path}  @{timestamp}")
+        del self.history_lines[:-200]
+
 
 def render_snapshot(model: DashboardModel) -> str:
     lines = [f"{'TOPIC PATH':40} {'NAME':20} {'PROTOCOL':30} TAGS"]
@@ -141,30 +180,74 @@ def run_dashboard(transport_kind: str | None = None,
     process.terminate()
 
 
-def _run_curses(model: DashboardModel) -> None:  # pragma: no cover
+def _run_curses(model: DashboardModel) -> None:
     import curses
 
-    def ui(screen) -> None:
-        curses.curs_set(0)
-        screen.nodelay(True)
-        index = 0
-        while True:
-            screen.erase()
-            rows = sorted(model.rows.items())
-            screen.addstr(0, 0, "aiko_services_tpu dashboard   "
-                          "(q quit, up/down select, k kill)",
+    curses.wrapper(lambda screen: _dashboard_ui(model, screen, curses))
+
+
+def _parse_edit_value(text: str):
+    """Edit input values cross as the most natural type: int/float when
+    they parse, bare string otherwise (the EC wire is text anyway)."""
+    for caster in (int, float):
+        try:
+            return caster(text)
+        except ValueError:
+            continue
+    return text
+
+
+def _dashboard_ui(model: DashboardModel, screen, curses) -> None:
+    """The curses loop, with screen + curses injectable so the
+    fake-curses tests drive it end-to-end.  Keys (reference
+    dashboard.py:232-235 edit, 565-648 history/log pages):
+      q quit | up/down select | k kill | l toggle log page
+      e edit -- type "name value", Enter publishes (update name value)
+        to the selected service's /control, Esc cancels
+      h history -- requests the selected registrar's event ring and
+        shows it; any key returns to the services page
+    """
+    curses.curs_set(0)
+    screen.nodelay(True)
+    index = 0
+    page = "services"
+    edit_buffer: str | None = None
+    status = ""
+    while True:
+        screen.erase()
+        rows = sorted(model.rows.items())
+        screen.addstr(0, 0, "aiko_services_tpu dashboard   "
+                      "(q quit, up/down select, k kill, e edit, "
+                      "h history, l log)", curses.A_BOLD)
+        if edit_buffer is not None:
+            screen.addstr(1, 0, f"update> {edit_buffer}", curses.A_BOLD)
+        elif status:
+            screen.addstr(1, 0, status, curses.A_DIM)
+        if page == "history":
+            screen.addstr(2, 0, f"history: {model.selected or '-'}",
                           curses.A_BOLD)
+            if not model.history_lines:
+                screen.addstr(3, 0, "(waiting for history...)",
+                              curses.A_DIM)
+            for row, line in enumerate(model.history_lines[:40]):
+                screen.addstr(row + 3, 0, str(line)[:120])
+        elif page == "log":
+            screen.addstr(2, 0, f"log: {model.selected or '-'}",
+                          curses.A_BOLD)
+            for row, line in enumerate(model.log_lines[-40:]):
+                screen.addstr(row + 3, 0, str(line)[:120])
+        else:
             for row, (topic_path, fields) in enumerate(rows[:30]):
                 marker = ">" if row == index else " "
                 line = (f"{marker} {topic_path:38.38} "
                         f"{str(fields.name):18.18} "
                         f"{str(fields.protocol).rsplit('/', 1)[-1]:20.20}")
-                screen.addstr(row + 2, 0, line)
+                screen.addstr(row + 3, 0, line)
             if rows and index < len(rows):
                 selected_topic, selected_fields = rows[index]
                 if model.selected != selected_topic:
                     model.select(selected_topic)
-                base = min(len(rows), 30) + 3
+                base = min(len(rows), 30) + 4
                 screen.addstr(base, 0, "share:", curses.A_BOLD)
                 offset = 0
                 for offset, (key, value) in enumerate(
@@ -176,16 +259,45 @@ def _run_curses(model: DashboardModel) -> None:  # pragma: no cover
                     for extra, line in enumerate(plugin(model)):
                         screen.addstr(base + offset + 2 + extra, 2,
                                       str(line)[:100], curses.A_DIM)
-            screen.refresh()
-            key = screen.getch()
-            if key == ord("q"):
-                return
-            if key == curses.KEY_DOWN:
-                index = min(index + 1, max(len(rows) - 1, 0))
-            elif key == curses.KEY_UP:
-                index = max(index - 1, 0)
-            elif key == ord("k"):
-                model.kill_selected()
+        screen.refresh()
+        key = screen.getch()
+        if key == -1:
             time.sleep(0.1)
-
-    curses.wrapper(ui)
+            continue
+        if edit_buffer is not None:
+            # inline edit line: printable chars accumulate, Enter
+            # commits, Esc cancels, backspace erases
+            if key in (10, 13):
+                parts = edit_buffer.strip().split(None, 1)
+                if len(parts) == 2:
+                    model.update_variable(
+                        parts[0], _parse_edit_value(parts[1]))
+                    status = f"sent (update {parts[0]} {parts[1]})"
+                else:
+                    status = "edit needs: name value"
+                edit_buffer = None
+            elif key == 27:
+                edit_buffer, status = None, "edit cancelled"
+            elif key in (curses.KEY_BACKSPACE, 127, 8):
+                edit_buffer = edit_buffer[:-1]
+            elif 32 <= key < 127:
+                edit_buffer += chr(key)
+            continue
+        if key == ord("q"):
+            return
+        if page in ("history", "log"):
+            page = "services"  # any key returns
+            continue
+        if key == curses.KEY_DOWN:
+            index = min(index + 1, max(len(rows) - 1, 0))
+        elif key == curses.KEY_UP:
+            index = max(index - 1, 0)
+        elif key == ord("k"):
+            model.kill_selected()
+        elif key == ord("e") and model.selected:
+            edit_buffer, status = "", ""
+        elif key == ord("h") and model.selected:
+            model.request_history()
+            page = "history"
+        elif key == ord("l") and model.selected:
+            page = "log"

@@ -775,7 +775,10 @@ def bench_serving(peak):
     from aiko_services_tpu.runtime import Process
 
     streams_n = 4 if SMOKE else 32
-    per_stream = 4 if SMOKE else 30
+    # 60 frames/stream: a ~1-2 s window per arm -- the 30-frame window
+    # was short enough for tunnel jitter to dominate the uncoalesced arm
+    # (observed medians 585 vs 1667 frames/s across two round-5 runs)
+    per_stream = 4 if SMOKE else 60
     config = DETECTOR_TOY if SMOKE else YOLOV8N_SHAPE
     preset = "toy" if SMOKE else "yolov8n"
     size = config.image_size
@@ -880,7 +883,8 @@ def bench_tts(peak):
 
     phrase = ("the quick brown fox jumps over the lazy dog"
               if not SMOKE else "hello")
-    batch = 2 if SMOKE else 8
+    batch = 2 if SMOKE else int(os.environ.get("AIKO_BENCH_TTS_BATCH",
+                                               "8"))
     warmup, measure = (2, 4) if SMOKE else (5, 40)
     config = TTSConfig()
     definition = {
