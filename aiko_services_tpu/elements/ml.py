@@ -93,10 +93,24 @@ def _load_transformer_params(element, config: TransformerConfig):
         is_hf = "model.embed_tokens.weight" in probe
         probe.close()
         if is_hf:
-            return load_llama_params(paths, config)
-        return load_pytree(paths[0], dtype=config.dtype)
-    return init_params(
-        config, jax.random.PRNGKey(int(element.get_parameter("seed", 0))))
+            params = load_llama_params(paths, config)
+        else:
+            params = load_pytree(paths[0], dtype=config.dtype)
+    else:
+        params = init_params(
+            config,
+            jax.random.PRNGKey(int(element.get_parameter("seed", 0))))
+    # "int8": weight-only serving quantization (halves the weight
+    # streaming that bounds small-batch decode); numerics pinned in
+    # tests/test_transformer.py::TestWeightOnlyInt8
+    weight_dtype = str(element.get_parameter("weight_dtype", "") or "")
+    if weight_dtype == "int8":
+        from ..models import quantize_weights_int8
+        params = quantize_weights_int8(params, config)
+    elif weight_dtype:
+        raise ValueError(
+            f"weight_dtype must be '' or 'int8', got {weight_dtype!r}")
+    return params
 
 
 def _probe_weight_names(weights) -> "SafetensorsFile":
@@ -131,9 +145,15 @@ def _default_state_spec(element, spec_factory) -> None:
 
 
 def _default_lm_state_spec(element, config) -> None:
-    from ..models import param_specs
-    _default_state_spec(
-        element, lambda: param_specs(config, lm_head=True))
+    from ..models import param_specs, quantized_param_specs
+    if str(element.get_parameter("weight_dtype", "") or "") == "int8":
+        # the quantized tree carries w_scale planes the plain specs
+        # don't know about
+        _default_state_spec(
+            element, lambda: quantized_param_specs(config, lm_head=True))
+    else:
+        _default_state_spec(
+            element, lambda: param_specs(config, lm_head=True))
 
 
 class LMForward(ComputeElement):

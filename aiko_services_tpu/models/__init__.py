@@ -1,7 +1,7 @@
 from .transformer import (                                    # noqa: F401
     TransformerConfig, init_params, param_specs, forward, init_cache,
     cache_specs, decode_step, generate, generate_stream, make_train_step,
-    count_params)
+    count_params, quantize_weights_int8, quantized_param_specs)
 from .tokenizer import BPETokenizer, train_bpe                # noqa: F401
 from .weights import (                                        # noqa: F401
     read_safetensors, write_safetensors, SafetensorsFile, save_pytree,

@@ -32,8 +32,20 @@ def init_dense(key, in_features: int, out_features: int,
 
 
 def dense(params: dict, x):
-    out = jnp.einsum("...i,io->...o", x, params["w"],
-                     preferred_element_type=jnp.float32)
+    w = params["w"]
+    if w.dtype == jnp.int8:
+        # weight-only int8 (transformer.quantize_weights_int8): weights
+        # stream from HBM as 8-bit codes -- the convert fuses into the
+        # dot's operand load -- and the per-output-channel scale folds
+        # in AFTER the f32 accumulation (scales factor out of the
+        # contraction), so the matmul itself never sees a dequantized
+        # copy in memory
+        out = jnp.einsum("...i,io->...o", x, w.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+        out = out * params["w_scale"].astype(jnp.float32)
+    else:
+        out = jnp.einsum("...i,io->...o", x, w,
+                         preferred_element_type=jnp.float32)
     if "b" in params:
         out = out + params["b"]
     return out.astype(x.dtype)

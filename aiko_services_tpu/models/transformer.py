@@ -38,6 +38,7 @@ __all__ = [
     "TransformerConfig", "init_params", "param_specs", "forward",
     "init_cache", "cache_specs", "decode_step", "generate",
     "generate_stream", "make_train_step", "count_params",
+    "quantize_weights_int8", "quantized_param_specs",
 ]
 
 
@@ -198,6 +199,71 @@ def param_specs(config: TransformerConfig,
 
 def count_params(params) -> int:
     return sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))
+
+
+# -- weight-only int8 (serving decode) ---------------------------------------
+
+_DENSE_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weights_int8(params: dict,
+                          config: TransformerConfig) -> dict:
+    """Weight-only int8 for SERVING: dense weights become 8-bit codes +
+    a per-output-channel f32 scale (kept at the weight's rank so specs
+    derive mechanically); embed / lm_head quantize per vocab ROW (one
+    scale serves both the gather and the logits matmul, where the
+    per-row scale factors out of the contraction).  Small-batch decode
+    is weight-streaming-bound, so halving the bytes read per step is
+    ~2x decode throughput at fixed batch.  Norms and biases stay f32;
+    MoE expert FFNs stay unquantized (their dispatch einsums bypass
+    dense()).  NOT for training -- optax rejects int8 leaves loudly."""
+    def quant(entry: dict, axis: int) -> dict:
+        w = entry["w"].astype(jnp.float32)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(w), axis=axis, keepdims=True), 1e-12) / 127.0
+        codes = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        out = {"w": codes, "w_scale": scale}
+        if "b" in entry:
+            out["b"] = entry["b"]
+        return out
+
+    dense_keys = (_DENSE_QUANT_KEYS[:4] if config.n_experts > 0
+                  else _DENSE_QUANT_KEYS)
+    layers = dict(params["layers"])
+    for key in dense_keys:
+        layers[key] = quant(layers[key], axis=-2)
+    quantized = dict(params)
+    quantized["layers"] = layers
+    quantized["embed"] = quant(params["embed"], axis=-1)
+    if "lm_head" in params:
+        quantized["lm_head"] = quant(params["lm_head"], axis=-1)
+    return quantized
+
+
+def quantized_param_specs(config: TransformerConfig,
+                          lm_head: bool = False) -> dict:
+    """param_specs + a spec per w_scale plane: same layout as its
+    weight with the quantization axis (collapsed to 1 by keepdims)
+    unsharded -- -2 for dense per-output-channel scales, -1 for the
+    embed/lm_head per-row scales."""
+    def scale_spec(spec: P, axis: int) -> P:
+        entries = list(tuple(spec))
+        entries[axis] = None
+        return P(*entries)
+
+    specs = param_specs(config, lm_head=lm_head)
+    dense_keys = (_DENSE_QUANT_KEYS[:4] if config.n_experts > 0
+                  else _DENSE_QUANT_KEYS)
+    layer = dict(specs["layers"])
+    for key in dense_keys:
+        layer[key] = dict(layer[key])
+        layer[key]["w_scale"] = scale_spec(layer[key]["w"], -2)
+    specs["layers"] = layer
+    for name in ("embed", "lm_head"):
+        if name in specs:
+            specs[name] = dict(specs[name])
+            specs[name]["w_scale"] = scale_spec(specs[name]["w"], -1)
+    return specs
 
 
 # -- KV cache ---------------------------------------------------------------
@@ -478,6 +544,12 @@ def forward(params: dict, config: TransformerConfig, tokens,
     # jnp.take's default FILL mode, whose NaN embeddings silently poison
     # every downstream activation
     h = jnp.take(params["embed"]["w"], tokens, axis=0, mode="clip")
+    if h.dtype == jnp.int8:
+        # int8 embed (quantize_weights_int8): gather the rows' scales
+        # alongside and dequantize only the gathered tokens
+        h = (h.astype(jnp.float32)
+             * jnp.take(params["embed"]["w_scale"], tokens, axis=0,
+                        mode="clip")).astype(config.jnp_dtype)
     if activation_specs:
         h = jax.lax.with_sharding_constraint(h, act_spec)
     positions = pos + jnp.arange(tokens.shape[1])
@@ -532,9 +604,13 @@ def forward(params: dict, config: TransformerConfig, tokens,
     h = rms_norm(params["norm_out"], h, config.norm_eps)
     # untied output head when the checkpoint ships one (Llama-3-8B+,
     # models/weights.py load_llama_params); tied embedding otherwise
-    head = params.get("lm_head", params["embed"])["w"]
+    head = params.get("lm_head", params["embed"])
     logits = jnp.einsum("bld,vd->blv", h.astype(jnp.float32),
-                        head.astype(jnp.float32))
+                        head["w"].astype(jnp.float32))
+    if head["w"].dtype == jnp.int8:
+        # per-row scales factor out of the contraction: the einsum
+        # streams 8-bit codes, the (V,) scale applies to the result
+        logits = logits * head["w_scale"][:, 0]
     if new_cache is None:
         if return_aux:
             return logits, aux_sum / max(config.n_layers, 1)

@@ -699,3 +699,24 @@ def test_lm_generate_kv_int8_parameter_matches_dense():
             definition, {"tokens": prompt})
         outs[label] = np.asarray(outputs["generated"])
     np.testing.assert_array_equal(outs["fp"], outs["q"])
+
+
+def test_lm_generate_weight_dtype_int8():
+    """weight_dtype="int8" at the ELEMENT level: serving decode with
+    8-bit weights produces a valid generation (numerics pinned at the
+    model level in TestWeightOnlyInt8)."""
+    prompt = np.array([[7, 8, 9, 10]], np.int32)
+    definition = {
+        "name": "w8", "graph": ["(lm)"],
+        "elements": [
+            {"name": "lm", "input": [{"name": "tokens"}],
+             "output": [{"name": "generated"}],
+             "parameters": {**TINY_LM, "max_new_tokens": 6,
+                            "weight_dtype": "int8"},
+             "deploy": local("LMGenerate")},
+        ],
+    }
+    [(_, _, outputs)] = run_frames_with_data(definition, {"tokens": prompt})
+    generated = np.asarray(outputs["generated"])
+    assert generated.shape == (1, 6)
+    assert ((generated >= 0) & (generated < TINY_LM["vocab_size"])).all()
