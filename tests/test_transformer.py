@@ -603,6 +603,43 @@ class TestLlama8BFeasibility:
         replicated = self._per_device_bytes(shapes, specs, {})
         assert replicated + cache_bytes + activation_bytes > budget
 
+    def test_8b_int8_fits_four_chips(self):
+        """int8 weights + int8 KV shrink the REAL Llama-3-8B serving
+        footprint enough for a v5e-4 (half the mesh the bf16 layout
+        needs): quantization buys mesh size, not just batch."""
+        import jax
+        from dataclasses import replace
+
+        from aiko_services_tpu.models import (
+            cache_specs, init_cache, init_params, quantize_weights_int8,
+            quantized_param_specs)
+        from aiko_services_tpu.models.configs import LLAMA3_8B
+
+        config = replace(LLAMA3_8B, kv_dtype="int8")
+        mesh_axes = {"data": 1, "fsdp": 2, "seq": 1, "model": 2}  # 4 chips
+        shapes = jax.eval_shape(lambda: quantize_weights_int8(
+            init_params(config, jax.random.PRNGKey(0)), config))
+        has_head = "lm_head" in shapes
+        specs = quantized_param_specs(config, lm_head=has_head)
+        specs = {key: specs[key] for key in shapes}
+        param_bytes = self._per_device_bytes(shapes, specs, mesh_axes)
+
+        batch, max_len = 8, config.max_seq_len
+        cache_shapes = jax.eval_shape(
+            lambda: init_cache(config, batch, max_len=max_len))
+        cache_bytes = self._per_device_bytes(
+            cache_shapes, cache_specs(quantized=True), mesh_axes)
+        activation_bytes = 2 * batch * max_len * config.d_model * 8
+
+        used = param_bytes + cache_bytes + activation_bytes
+        budget = self.V5E_HBM_BYTES * self.BUDGET
+        assert used < budget, (
+            f"int8 8B does not fit 4 chips: params "
+            f"{param_bytes/2**30:.2f} GiB + cache "
+            f"{cache_bytes/2**30:.2f} GiB + activations "
+            f"{activation_bytes/2**30:.2f} GiB = {used/2**30:.2f} GiB "
+            f"> budget {budget/2**30:.2f} GiB")
+
     def test_8b_pipeline_definition_compiles_on_virtual_mesh(self):
         """examples/pipeline_llm_8b.json executes end to end on the
         virtual 8-CPU mesh at ARCHITECTURE dims (real depth/GQA/mesh
