@@ -125,3 +125,31 @@ def test_headline_survives_device_fallback_field():
     line = bench.compact_headline(detail)
     assert len(line) <= bench.HEADLINE_LINE_CAP
     assert json.loads(line)["smoke"] is True
+
+
+def test_subset_runs_do_not_clobber_detail_file(tmp_path, monkeypatch):
+    """A subset bench run must never overwrite BENCH_DETAIL.json -- the
+    repo's committed end-to-end evidence record (round-5 review
+    finding: an llm-only run replaced the full record with a partial
+    one whose headline masqueraded as the pipeline metric)."""
+    import subprocess
+
+    repo = Path(__file__).resolve().parent.parent
+    detail = repo / "BENCH_DETAIL.json"
+    before = detail.read_text() if detail.exists() else None
+    import os
+    env = dict(os.environ)
+    env.update(AIKO_BENCH_SMOKE="1", AIKO_BENCH_PROBE="0",
+               AIKO_BENCH_PLATFORM="cpu", AIKO_BENCH_CONFIGS="text",
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    result = subprocess.run(
+        [sys.executable, str(repo / "bench.py")], env=env,
+        capture_output=True, text=True, timeout=600)
+    assert result.returncode == 0, result.stderr[-2000:]
+    final = result.stdout.strip().splitlines()[-1]
+    parsed = json.loads(final)
+    # honest labeling: a subset headline names its config
+    assert parsed["metric"] == "text_headline_subset_run"
+    after = detail.read_text() if detail.exists() else None
+    assert after == before, "subset run clobbered BENCH_DETAIL.json"

@@ -1033,3 +1033,37 @@ def test_micro_batch_per_signature_capacity_flush():
     # one FULL group per cohort (4 frames x 2 rows = 8), not 4 partials
     assert stream.variables["batches"] == [8, 8], stream.variables
     process.terminate()
+
+
+def test_micro_batch_capacity_flush_keeps_window_for_other_cohort():
+    """A capacity flush of one ripe signature must leave the hold-down
+    window covering the OTHER cohort's parked frames -- they flush at
+    the window deadline, not never (starvation guard for the
+    per-signature capacity fix)."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    definition = _micro_definition(micro_batch=4)
+    definition["elements"][0]["parameters"]["micro_batch_wait_ms"] = 150
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    process.run(in_thread=True)
+    # cohort B: two frames (below capacity), then cohort A fills to 4
+    # while B's window is open
+    for shape in [(2, 5), (2, 5), (2, 3), (2, 3), (2, 3), (2, 3)]:
+        pipeline.create_frame(
+            stream, {"x": np.zeros(shape, np.float32)})
+    got = 0
+    deadline = time.monotonic() + 20
+    while got < 6 and time.monotonic() < deadline:
+        try:
+            responses.get(timeout=5)
+            got += 1
+        except queue.Empty:
+            break
+    assert got == 6, f"only {got}/6 frames returned (cohort starved?)"
+    # cohort A (4 frames) flushed at capacity as one full group (8
+    # rows); cohort B (2 frames) flushed by the window timer, padded to
+    # full (8 rows)
+    assert sorted(stream.variables["batches"]) == [8, 8], stream.variables
+    process.terminate()
