@@ -23,7 +23,7 @@ import hashlib
 import json
 import time
 
-from ..runtime import Actor, ECProducer, Lease, ServiceFilter, ServicesCache
+from ..runtime import Actor, Lease, ServiceFilter, ServicesCache
 from ..runtime.service import SERVICE_PROTOCOL_PIPELINE
 from ..utils import generate, get_logger, load_module
 from ..utils.padding import bucket_length, pad_axis_to
