@@ -229,7 +229,9 @@ def _dashboard_ui(model: DashboardModel, screen, curses) -> None:
             if not model.history_lines:
                 screen.addstr(3, 0, "(waiting for history...)",
                               curses.A_DIM)
-            for row, line in enumerate(model.history_lines[:40]):
+            # newest entries: the handler trims keeping the TAIL, so
+            # with >40 buffered lines the head is the stale end
+            for row, line in enumerate(model.history_lines[-40:]):
                 screen.addstr(row + 3, 0, str(line)[:120])
         elif page == "log":
             screen.addstr(2, 0, f"log: {model.selected or '-'}",

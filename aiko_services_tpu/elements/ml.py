@@ -369,6 +369,36 @@ class LMGenerate(ComputeElement):
     def compute(self, state, **inputs):  # pragma: no cover
         raise NotImplementedError("LMGenerate overrides process_frame")
 
+    def group_kernel(self, stream):
+        """Fused micro-batch hook for the decode stage: greedy
+        generation (prefill + fori_loop, already one device program)
+        traced into the scheduler's fused group program.  Falls back to
+        the chained path whenever process_frame does per-frame host
+        work the kernel cannot reproduce: text prompts / tokenizer
+        decode, token streaming, sequence-parallel padding, meshed
+        placement."""
+        from ..utils import truthy
+        self._ensure_ready()  # configure(): config + tokenizer exist
+        if (self.mesh is not None or self.config.sequence_parallel
+                or self.tokenizer is not None
+                or truthy(self.get_parameter(
+                    "stream_tokens", False, stream))):
+            return None
+        max_new = int(self.get_parameter("max_new_tokens", 32, stream))
+
+        def build():
+            config = self.config
+
+            def kernel(state, tokens):
+                out, _ = generate(state, config,
+                                  jnp.asarray(tokens, jnp.int32),
+                                  max_new)
+                return {"generated": out}
+
+            return kernel
+
+        return self._cached_group_kernel(max_new, build), self.state
+
 
 # byte-level toy vocabulary shared by SpeechToText and TokensToText:
 # 0=pad 1=sot 2=eot, 3..258 = bytes
@@ -465,6 +495,30 @@ class SpeechToText(ComputeElement):
         tokens = transcribe_audio(self.state, self.config, audio,
                                   max_tokens=max_tokens)
         return StreamEvent.OKAY, {"tokens": tokens}
+
+    def group_kernel(self, stream):
+        """Fused micro-batch hook: log-mel frontend + transcription as a
+        pure batch kernel inside the scheduler's fused group program.
+        max_tokens is a compile-time loop bound, so kernels cache per
+        resolved value (stable identity keeps the scheduler's compiled
+        program cached)."""
+        if self.mesh is not None:
+            return None  # meshed inputs need host-side placement
+        self._ensure_ready()
+        max_tokens = int(self.get_parameter("max_tokens", 32, stream))
+
+        def build():
+            from ..models.asr import transcribe_audio
+            config = self.config
+
+            def kernel(state, audio):
+                audio = jnp.asarray(audio, jnp.float32)
+                return {"tokens": transcribe_audio(
+                    state, config, audio, max_tokens=max_tokens)}
+
+            return kernel
+
+        return self._cached_group_kernel(max_tokens, build), self.state
 
 
 class TextToSpeech(ComputeElement):
@@ -696,3 +750,25 @@ class Detector(ComputeElement):
         else:
             detections = detect(self.state, self.config, image)
         return StreamEvent.OKAY, {"detections": detections}
+
+    def group_kernel(self, stream):
+        """Fused micro-batch hook: detection as a pure batch kernel, so
+        the scheduler runs concat+detect+split as ONE program (the
+        round-5 standalone probe: 1 642 frames/s fused vs 1 403 for the
+        three-dispatch chain on this serving path)."""
+        if self.mesh is not None:
+            return None  # meshed inputs need host-side placement
+        self._ensure_ready()
+        if self._group_kernel_fn is None:
+            if self._yolo:
+                from ..models import yolo_detect as detect_fn
+            else:
+                detect_fn = detect
+            config = self.config
+
+            def kernel(state, image):
+                image = jnp.asarray(image, jnp.float32)
+                return {"detections": detect_fn(state, config, image)}
+
+            self._group_kernel_fn = kernel
+        return self._group_kernel_fn, self.state

@@ -26,6 +26,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams; support both so the
+# kernels trace on either runtime (the tunneled TPU toolchain and the
+# CPU test environment may pin different jax versions)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
 from jax.sharding import PartitionSpec as P
 
 from ..utils.padding import pad_axis_to
@@ -238,7 +244,7 @@ def _flash_impl(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),   # l
             pltpu.VMEM((block_q, head_dim), jnp.float32),      # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q_padded, k_padded, v_padded)
@@ -416,7 +422,7 @@ def _flash_bwd_impl(q, k, v, out, lse, dout, causal, sm_scale, block_q,
         out_shape=jax.ShapeDtypeStruct(
             (batch * heads, padded_q_len, head_dim), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q_p, k_p, v_p, do_p, lse_p, delta_p)
@@ -455,7 +461,7 @@ def _flash_bwd_impl(q, k, v, out, lse, dout, causal, sm_scale, block_q,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, head_dim), jnp.float32),
                         pltpu.VMEM((block_k, head_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q_p, k_p, v_p, do_p, lse_p, delta_p)

@@ -109,6 +109,31 @@ class PipelineElement(Actor):
     def stop_stream(self, stream: Stream, stream_id) -> tuple:
         return StreamEvent.OKAY, None
 
+    def group_kernel(self, stream: Stream):
+        """Optional fused whole-group execution hook for the micro-batch
+        scheduler.  Return `(kernel, context)` where
+        `kernel(context, **batch) -> dict` is a PURE jit-traceable
+        function (batch-in/batch-out on axis 0, no host side effects)
+        and `context` is a pytree of traced values (model state, dynamic
+        parameters).  When present, the scheduler traces
+        concat+pad+kernel+split as ONE compiled program per (input
+        names, arity, shapes) signature instead of three dispatches --
+        on tunneled devices each dispatch costs ~10-40 ms, so the fused
+        program is the serving hot path.  Contract details:
+
+        - `context` rides the program as a traced argument, never a
+          baked-in constant: checkpoint restores and live parameter
+          updates apply without a stale executable (return fresh
+          context each call; keep the KERNEL's identity stable -- the
+          scheduler caches the compiled program per kernel object).
+        - Outputs whose leading axis equals the coalesced batch are
+          split per frame (recursing into dicts); anything else -- and
+          ports declared "batched": false -- is shared whole.
+        - Return None (the default) to use the chained
+          concat -> process_frame -> split path.
+        """
+        return None
+
     # -- frame creation ----------------------------------------------------
 
     def create_frame(self, stream: Stream, frame_data: dict) -> None:

@@ -71,9 +71,23 @@ _SPLIT_JIT = None
 _COALESCE_JIT = None
 
 
-def _concat_pad_program(named_arrays: dict, target: int):
+def _concat_pad(named: dict, target: int) -> dict:
     """Concat each input's per-frame arrays on axis 0 and pad to
-    `target` rows as ONE compiled program.  The eager concatenate this
+    `target` rows.  The ONE definition of the coalesce math: the
+    standalone jitted program (chained path) and the fused group
+    program both trace THIS function, so the fused==chained
+    equivalence can never drift."""
+    import jax.numpy as jnp
+    out = {}
+    for name, arrays in named.items():
+        value = (arrays[0] if len(arrays) == 1
+                 else jnp.concatenate(arrays, axis=0))
+        out[name] = pad_axis_to(value, 0, target)
+    return out
+
+
+def _concat_pad_program(named_arrays: dict, target: int):
+    """_concat_pad as ONE compiled program.  The eager concatenate this
     replaces cost ~40 ms of tunnel dispatch PER GROUP on the tunneled
     TPU (measured round 5: 310 frames/s eager vs 1 403 jitted on the
     yolov8n serving chain), swamping the coalesced call it was
@@ -85,18 +99,9 @@ def _concat_pad_program(named_arrays: dict, target: int):
         import functools
 
         import jax
-        import jax.numpy as jnp
 
-        @functools.partial(jax.jit, static_argnames=("target",))
-        def concat_pad(named, target):
-            out = {}
-            for name, arrays in named.items():
-                value = (arrays[0] if len(arrays) == 1
-                         else jnp.concatenate(arrays, axis=0))
-                out[name] = pad_axis_to(value, 0, target)
-            return out
-
-        _COALESCE_JIT = concat_pad
+        _COALESCE_JIT = functools.partial(
+            jax.jit, static_argnames=("target",))(_concat_pad)
     return _COALESCE_JIT(named_arrays, target)
 
 
@@ -179,6 +184,19 @@ class Pipeline(Actor):
         # zero-filler buffers reused across coalesced groups (immutable
         # device arrays; a fresh zeros_like per group is a dispatch)
         self._micro_fillers: dict[tuple, object] = {}
+        # fused whole-group programs: node -> {kernel id: (kernel,
+        # jitted concat+pad+kernel+split)}; jit caches one executable
+        # per (input names, arity, shapes) signature underneath.  A
+        # DICT per node, not one slot: elements cache one kernel per
+        # static parameter value (max_new_tokens, max_tokens), and
+        # alternating cohorts must not evict each other's compiled
+        # programs (a rebuild discards every XLA executable under it)
+        self._fused_programs: dict[str, dict] = {}
+        self._fused_rejected: set = set()
+        # elements whose parked frames split into parameter-fingerprint
+        # cohorts, logged once each (operators see WHY cross-stream
+        # coalescing produced small groups)
+        self._micro_cohort_logged: set = set()
         # open hold-down windows: node -> timer fn (see
         # _schedule_micro_flush); generations invalidate STALE posted
         # flush messages from superseded windows
@@ -216,6 +234,16 @@ class Pipeline(Actor):
                         f"{element_class.__name__} is not a PipelineElement")
                 element = element_class(
                     self.process, self, element_definition)
+                if isinstance(element, AsyncHostElement) and (
+                        type(element).group_kernel
+                        is not PipelineElement.group_kernel):
+                    raise TypeError(
+                        f"{element_definition.name}: AsyncHostElement "
+                        f"cannot expose a group kernel -- its work runs "
+                        f"on a host worker thread (device readbacks, "
+                        f"blocking I/O) and cannot trace into a fused "
+                        f"device program; drop group_kernel or use a "
+                        f"ComputeElement")
                 self.elements[element_definition.name] = element
             else:
                 remote = RemoteElement(self, element_definition)
@@ -823,6 +851,22 @@ class Pipeline(Actor):
                 else:
                     rest.append(entry)
             pending = rest
+            if node_name not in self._micro_cohort_logged:
+                # same shapes but different parameter fingerprints:
+                # streams that cannot share a call.  Said once (debug)
+                # so operators see why coalesced groups came up small
+                # instead of it degrading silently
+                other_cohorts = {entry[3][1] for entry in rest
+                                 if entry[3][0] == signature[0]
+                                 and entry[3][1] != signature[1]}
+                if other_cohorts:
+                    self._micro_cohort_logged.add(node_name)
+                    _LOGGER.debug(
+                        "%s: %s parked frames split into %d "
+                        "parameter-fingerprint cohorts (streams resolve "
+                        "parameters differently, so cross-stream "
+                        "coalescing runs smaller groups)",
+                        self.name, node_name, 1 + len(other_cohorts))
             # frames finished elsewhere / destroyed streams: never resume
             group = [
                 entry for entry in group
@@ -838,8 +882,12 @@ class Pipeline(Actor):
         rampup/drain partial groups reuse the steady-state compilation
         (micro_batch_pad_full=false falls back to power-of-two buckets)
         -- split outputs back per frame, resume each through the normal
-        graph path ON ITS OWN STREAM (per-stream response routing)."""
-        import jax.numpy as jnp
+        graph path ON ITS OWN STREAM (per-stream response routing).
+
+        Two execution paths: elements exposing a group kernel run
+        concat+pad+kernel+split as ONE fused program
+        (_call_fused_group); everything else runs the chained
+        jitted-concat -> process_frame -> jitted-split trio."""
         node_name = element.definition.name
         lead_stream = group[0][0]
         rows = [next(iter(inputs.values())).shape[0]
@@ -852,39 +900,34 @@ class Pipeline(Actor):
                       else bucket_length(total, minimum=rows[0]))
         else:
             target = bucket_length(total, minimum=rows[0])
-        if len(group) == 1 and target == total:
-            coalesced = dict(group[0][2])
-        else:
-            # pad the ENTRY LIST to exactly `micro` arrays with zero
-            # fillers when padding to full: the concat program is then
-            # one fixed shape per signature instead of one per group
-            # size (each distinct arity would cost an XLA compile --
-            # measured to dominate serving throughput on the tunnel)
-            fillers = (micro - len(group)
-                       if target == full and len(group) < micro else 0)
-            named_arrays = {}
-            for name in group[0][2]:
-                arrays = [inputs[name] for _, _, inputs, _ in group]
-                if fillers:
-                    key = (tuple(arrays[0].shape), str(arrays[0].dtype))
-                    filler = self._micro_fillers.get(key)
-                    if filler is None:
-                        if len(self._micro_fillers) >= 32:
-                            # bounded: variable-shape workloads must not
-                            # pin device buffers forever
-                            self._micro_fillers.clear()
-                        filler = jnp.zeros_like(arrays[0])
-                        self._micro_fillers[key] = filler
-                    arrays.extend([filler] * fillers)
-                named_arrays[name] = tuple(arrays)
-            coalesced = _concat_pad_program(named_arrays, target)
+        # pad the ENTRY LIST to exactly `micro` arrays with zero
+        # fillers when padding to full: the concat program is then
+        # one fixed shape per signature instead of one per group
+        # size (each distinct arity would cost an XLA compile --
+        # measured to dominate serving throughput on the tunnel).
+        # split_rows mirrors the fillers so partial (rampup/drain)
+        # groups also reuse the steady-state SPLIT executable
+        fillers = (micro - len(group)
+                   if target == full and len(group) < micro else 0)
+        split_rows = rows + [rows[0]] * fillers if fillers else rows
+        kernel_spec = self._resolve_group_kernel(element, lead_stream)
         # the element sees the LEAD stream (parameter fingerprints
         # guarantee every stream in the group resolves its parameters
         # identically, so the choice is immaterial)
         lead_stream.current_frame_id = group[0][1].frame_id
+        per_frame = None
         element_start = time.perf_counter()
-        stream_event, outputs = self._safe_call(
-            element.process_frame, lead_stream, **coalesced)
+        if kernel_spec is not None:
+            stream_event, outputs, per_frame = self._call_fused_group(
+                element, group, kernel_spec, target, split_rows, fillers)
+        else:
+            if len(group) == 1 and target == total:
+                coalesced = dict(group[0][2])
+            else:
+                named_arrays = self._gather_named_arrays(group, fillers)
+                coalesced = _concat_pad_program(named_arrays, target)
+            stream_event, outputs = self._safe_call(
+                element.process_frame, lead_stream, **coalesced)
         elapsed = time.perf_counter() - element_start
         share = elapsed / len(group)
         if stream_event == StreamEvent.PENDING:
@@ -903,18 +946,12 @@ class Pipeline(Actor):
                     f"only resume one frame); use an AsyncHostElement "
                     f"or micro_batch: 1")}
         if stream_event == StreamEvent.OKAY:
-            shared_outputs = {
-                port["name"] for port in element.definition.output
-                if not port.get("batched", True)}
-            # split into the FULL micro count when padded to full, so
-            # partial (rampup/drain) groups reuse the steady-state split
-            # executable -- a fresh counts tuple costs a ~2 s tunnel
-            # compile; the padding frames' slices go unused
-            split_rows = rows
-            if target == full and len(rows) < micro:
-                split_rows = rows + [rows[0]] * (micro - len(rows))
-            per_frame = self._split_micro_outputs_all(
-                outputs or {}, split_rows, target, shared_outputs)
+            if per_frame is None:  # chained path: split as its own program
+                shared_outputs = {
+                    port["name"] for port in element.definition.output
+                    if not port.get("batched", True)}
+                per_frame = self._split_micro_outputs_all(
+                    outputs or {}, split_rows, target, shared_outputs)
             for (stream, frame, _, _), frame_outputs in zip(group,
                                                             per_frame):
                 if (self.streams.get(stream.stream_id) is not stream
@@ -955,6 +992,143 @@ class Pipeline(Actor):
                         stream.stream_id for stream, _, _, _ in group):
                     self.destroy_stream(stream_id,
                                         state=StreamState.ERROR)
+
+    def _gather_named_arrays(self, group: list, fillers: int) -> dict:
+        """{input name: tuple of per-frame arrays}, entry list padded
+        with cached zero fillers to keep arity stable (one compile per
+        signature, not per group size)."""
+        import jax.numpy as jnp
+        named_arrays = {}
+        for name in group[0][2]:
+            arrays = [inputs[name] for _, _, inputs, _ in group]
+            if fillers:
+                key = (tuple(arrays[0].shape), str(arrays[0].dtype))
+                filler = self._micro_fillers.get(key)
+                if filler is None:
+                    if len(self._micro_fillers) >= 32:
+                        # bounded: variable-shape workloads must not
+                        # pin device buffers forever
+                        self._micro_fillers.clear()
+                    filler = jnp.zeros_like(arrays[0])
+                    self._micro_fillers[key] = filler
+                arrays.extend([filler] * fillers)
+            named_arrays[name] = tuple(arrays)
+        return named_arrays
+
+    def _resolve_group_kernel(self, element, stream: Stream):
+        """The element's fused-path hook, resolved defensively: an
+        unimplemented hook, a falsy `micro_batch_fused` parameter, or a
+        raising hook all fall back to the chained path (the failure
+        mode is the pre-fusion dispatch chain, never a lost frame)."""
+        if (type(element).group_kernel
+                is PipelineElement.group_kernel):
+            return None  # hook not implemented: chained path
+        from ..utils import truthy
+        if not truthy(element.get_parameter(
+                "micro_batch_fused", True, stream)):
+            return None
+        try:
+            spec = element.group_kernel(stream)
+            if spec is None:
+                return None
+            kernel, context = spec  # malformed return -> chained path
+            if not callable(kernel):
+                raise TypeError(
+                    f"group_kernel must return (callable, context), "
+                    f"got ({type(kernel).__name__}, ...)")
+        except Exception as error:
+            if element.definition.name not in self._fused_rejected:
+                self._fused_rejected.add(element.definition.name)
+                _LOGGER.warning(
+                    "%s: %s group_kernel failed (%s); using the chained "
+                    "micro-batch path", self.name,
+                    element.definition.name, error)
+            return None
+        return kernel, context
+
+    def _call_fused_group(self, element, group: list, kernel_spec,
+                          target: int, split_rows: list,
+                          fillers: int) -> tuple:
+        """ONE compiled XLA program for the whole group: the concat+pad
+        of every input, the element's group kernel, and the per-frame
+        output split trace together, so the tunneled dispatch cost is
+        paid once per group instead of three times (standalone probe,
+        round 5: 1 642 frames/s fused vs 1 403 chained vs 310 eager on
+        the yolov8n serving chain).  Returns (StreamEvent, outputs,
+        per-frame output dicts | None)."""
+        kernel, context = kernel_spec
+        named_arrays = self._gather_named_arrays(group, fillers)
+        shared = tuple(sorted(
+            port["name"] for port in element.definition.output
+            if not port.get("batched", True)))
+        program = self._fused_program_for(element.definition.name, kernel)
+        try:
+            per_frame = program(
+                context, named_arrays, target=int(target),
+                counts=tuple(int(count) for count in split_rows),
+                shared=shared)
+        except Exception as error:
+            import traceback
+            return StreamEvent.ERROR, {
+                "diagnostic": f"fused group kernel failed: {error}",
+                "traceback": traceback.format_exc()}, None
+        return StreamEvent.OKAY, {}, list(per_frame[:len(group)])
+
+    def _fused_program_for(self, node_name: str, kernel):
+        """Cached jit of concat+pad -> kernel -> split for one element,
+        keyed by kernel identity: elements keep their kernel objects
+        stable (one per static parameter value), so each program (and
+        every per-signature executable under it) persists across groups
+        even when cohorts alternate; a fresh kernel closure only costs
+        a rebuild, never a wrong result.  The id key stays valid while
+        the entry holds the kernel strongly; a reused id after GC fails
+        the identity check and rebuilds."""
+        programs = self._fused_programs.setdefault(node_name, {})
+        entry = programs.get(id(kernel))
+        if entry is not None and entry[0] is kernel:
+            return entry[1]
+        import functools
+
+        import jax
+
+        def slice_rows(value, offset, count, target):
+            if isinstance(value, dict):
+                return {name: slice_rows(child, offset, count, target)
+                        for name, child in value.items()}
+            if (hasattr(value, "ndim") and getattr(value, "ndim", 0) >= 1
+                    and value.shape[0] == target):
+                return value[offset:offset + count]
+            if isinstance(value, list) and len(value) == target:
+                # per-row Python list: same split rule as the chained
+                # path's _split_micro_outputs_all host-list branch
+                return value[offset:offset + count]
+            return value  # leading axis not the batch: shared whole
+
+        @functools.partial(jax.jit,
+                           static_argnames=("target", "counts", "shared"))
+        def fused(context, named, target, counts, shared):
+            batch = _concat_pad(named, target)
+            outputs = kernel(context, **batch)
+            if not isinstance(outputs, dict):
+                raise TypeError(
+                    f"{node_name}: group kernel must return a dict, "
+                    f"got {type(outputs)}")
+            frames = []
+            offset = 0
+            for count in counts:
+                frames.append({
+                    name: (value if name in shared
+                           else slice_rows(value, offset, count, target))
+                    for name, value in outputs.items()})
+                offset += count
+            return tuple(frames)
+
+        if len(programs) >= 8:
+            # bounded: an element returning a FRESH closure every call
+            # must not leak one dead program per group
+            programs.clear()
+        programs[id(kernel)] = (kernel, fused)
+        return fused
 
     def _split_micro_outputs_all(self, outputs: dict, rows: list,
                                  target: int, shared: set) -> list:

@@ -92,6 +92,7 @@ class ComputeElement(PipelineElement):
         self._compiled = None
         self._accepts_lengths = False
         self._replicated_warned: set = set()
+        self._group_kernel_fn = None
 
     # -- the compute contract (override these) -----------------------------
 
@@ -201,6 +202,57 @@ class ComputeElement(PipelineElement):
                     sliced_axes.add(axis)
             result[name] = value
         return result
+
+    def group_kernel(self, stream: Stream):
+        """Fused whole-group execution for free: compute() exposed as a
+        batch-in/batch-out kernel so the micro-batch scheduler traces
+        concat+pad+compute+split as ONE program (PipelineElement
+        .group_kernel contract).  State and dynamic parameters ride the
+        traced `context` -- never baked-in constants -- so checkpoint
+        restores and live parameter updates apply without a stale
+        executable.  Elements whose engine path does host-side per-frame
+        work fall back to the chained path: bucket padding and `lengths`
+        masks depend on pre-padding sizes, meshed inputs need NamedSharding
+        placement, blocking_metrics promises an in-window
+        block_until_ready, and a custom process_frame override means
+        compute() alone would not reproduce the element's behavior."""
+        if (self._bucket_axes or self.mesh is not None
+                or self._blocking_metrics):
+            return None
+        if (type(self).process_frame is not ComputeElement.process_frame
+                or type(self).compute is ComputeElement.compute):
+            return None
+        self._ensure_ready()
+        if self._accepts_lengths:
+            return None
+        if self._group_kernel_fn is None:
+            def kernel(context, **batch):
+                state, dynamic = context
+                outputs = self.compute(state, **dynamic, **batch)
+                if not isinstance(outputs, dict):
+                    raise TypeError(
+                        f"{self.definition.name}.compute must return "
+                        f"a dict")
+                return outputs
+
+            self._group_kernel_fn = kernel
+        dynamic = {
+            key: jnp.asarray(value)
+            for key, value in self.dynamic_parameters(stream).items()}
+        return self._group_kernel_fn, (self.state, dynamic)
+
+    def _cached_group_kernel(self, key, build):
+        """Per-static-parameter-value kernel cache for group_kernel
+        overrides (e.g. one kernel per max_tokens): a STABLE kernel
+        identity per value keeps the scheduler's compiled fused program
+        (and every executable under it) cached across groups."""
+        kernels = getattr(self, "_group_kernels", None)
+        if kernels is None:
+            kernels = self._group_kernels = {}
+        kernel = kernels.get(key)
+        if kernel is None:
+            kernel = kernels[key] = build()
+        return kernel
 
     def restore_state(self, state) -> None:
         """Install checkpointed state (numpy pytree from Checkpointer),

@@ -540,14 +540,27 @@ class Client:
             _time.sleep(backoff)
             backoff = min(backoff * 2, 8.0)
 
+    def _send_keepalive_ping(self) -> None:
+        """Counted keepalive PINGREQ, with the same rollback as flush():
+        a ping that never hit the wire gets no PINGRESP, so the count
+        must not stand.  The read loop's connection-loss resync USUALLY
+        covers a failed send, but a transient failure on a socket that
+        then recovers would otherwise leave flush() waiters one
+        PINGRESP short forever."""
+        with self._ping_cond:
+            self._ping_sent += 1
+            generation = self._ping_gen
+        if self._send(_packet(PINGREQ, 0, b"")) != 0:
+            with self._ping_cond:
+                if self._ping_gen == generation:
+                    self._ping_sent -= 1
+
     def _read_until_closed(self, sock) -> None:
         while not self._closing:
             try:
                 packet = _read_packet(sock)
             except socket.timeout:
-                with self._ping_cond:
-                    self._ping_sent += 1
-                self._send(_packet(PINGREQ, 0, b""))  # keepalive
+                self._send_keepalive_ping()
                 continue
             if packet is None:
                 return

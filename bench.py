@@ -138,7 +138,8 @@ def _honest_elapsed(start, refs):
 
 def _run_pipeline(definition, warmup: int, measure: int,
                   ready_key: str, timeout: float = 900,
-                  latency_frames: int | None = None):
+                  latency_frames: int | None = None,
+                  window: int | None = None):
     """Drive a pipeline with its own frame generator.
 
     Two phases: (1) throughput -- the generator keeps the pipeline full
@@ -160,7 +161,8 @@ def _run_pipeline(definition, warmup: int, measure: int,
     pipeline = create_pipeline(process, definition)
     process.run(in_thread=True)
     responses = queue.Queue()
-    window = int(os.environ.get("AIKO_BENCH_WINDOW", "64"))
+    if window is None:
+        window = int(os.environ.get("AIKO_BENCH_WINDOW", "64"))
     pipeline.create_stream("bench", queue_response=responses,
                            grace_time=1800,
                            parameters={"frame_window": window})
@@ -648,37 +650,18 @@ def bench_llm_sharded():
 
 # -- config 5: 3-stage multi-modal pipeline ---------------------------------
 
-def bench_multimodal(peak):
-    """BASELINE config 5 at the NAMED reference-scale stages: the
-    whisper_small ASR preset, the llama32_1b LM, and the yolov8n 640 px
-    detector -- the same model configs benched individually as configs
-    2/3/4 (SMOKE shrinks everything for CPU runs).  Each frame carries
-    `batch` audio windows + images; micro_batch coalesces queued frames
-    into one jit call per stage."""
-    from aiko_services_tpu.models import (
-        asr_flops_per_example, detector_flops_per_image,
-        transformer_flops_per_token)
+def _multimodal_setup(name, batch, micro, max_tokens, max_new,
+                      audio_seconds, frame_count):
+    """Definition + model configs for the config-5 graph at one
+    operating point (rows per frame, frames coalesced per jit call) --
+    shared by the throughput (micro 8 / window 64) and latency
+    (micro 1 / window 1) configs so the two frontier points measure
+    the SAME graph."""
     from aiko_services_tpu.models import configs as model_configs
     from aiko_services_tpu.models.asr import AsrConfig
     from aiko_services_tpu.models.detector import DetectorConfig
     from aiko_services_tpu.models.transformer import TransformerConfig
 
-    warmup, measure = (2, 8) if SMOKE else (10, 120)
-    # 5 s chunks = the reference speech cadence (audio_io.py:455-460)
-    audio_seconds = 1.0 if SMOKE else 5.0
-    # rows per frame (data_batch_size) x frames coalesced per jit call;
-    # env-tunable for scaling experiments.  Measured on v5e round 5
-    # (after the jitted coalesce program landed): rows 16 / micro 8 /
-    # window 64 -> 18.95 fps, MFU 0.263; micro 4 -> 10.7 fps / 0.149;
-    # rows 24 collapsed to 3.2 fps (compile-bound) and micro 16
-    # (batch-256 stages) stalled the 900 s response timeout compiling
-    batch = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_ROWS", "16"))
-    micro = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_MICRO", "8"))
-    max_tokens = 16
-    # the LM stage DECODES (greedy, one jit: prefill + fori_loop), the
-    # reference's chat semantics (elements_llm.py:181-210) -- not a
-    # scoring pass
-    max_new = 8 if SMOKE else int(os.environ.get("AIKO_BENCH_NEW", "32"))
     if SMOKE:
         image_size = 64
         lm = dict(vocab_size=1024, d_model=256, n_layers=2, n_heads=8,
@@ -709,7 +692,7 @@ def bench_multimodal(peak):
         det_config = model_configs.YOLOV8N_SHAPE
         image_size = det_config.image_size
     definition = {
-        "name": "bench_multimodal",
+        "name": name,
         "graph": ["(sources (asr (text) (lm (reply))) (detector))"],
         "elements": [
             {"name": "sources",
@@ -719,7 +702,7 @@ def bench_multimodal(peak):
                             "image_shape": [3, image_size, image_size],
                             "data_batch_size": batch,
                             "timestamps": True, "on_device": ON_DEVICE,
-                            "count": warmup + measure + 4},
+                            "count": frame_count},
              "deploy": _local("MultiModalSource")},
             {"name": "asr", "input": [{"name": "audio"}],
              "output": [{"name": "tokens"}],
@@ -742,18 +725,65 @@ def bench_multimodal(peak):
              "parameters": det, "deploy": _local("Detector")},
         ],
     }
-    fps, p50, drain_pf, _ = _run_pipeline(
-        definition, warmup=warmup, measure=measure, ready_key="detections")
-    # per-frame compute across the three model stages (batch rows each)
+    return definition, asr_config, lm_config, det_config
+
+
+def _multimodal_flops(asr_config, lm_config, det_config, batch,
+                      max_tokens, max_new, audio_seconds):
+    """Per-frame compute across the three model stages (batch rows
+    each)."""
+    from aiko_services_tpu.models import (
+        asr_flops_per_example, detector_flops_per_image,
+        transformer_flops_per_token)
     n_frames = int(audio_seconds * 100) // 2
     # LM: prefill over the prompt + max_new decode steps (per-token
     # flops at the FINAL context slightly overstates the quadratic
     # attention term; negligible at ctx <= 48 on a 1B)
     lm_tokens = max_tokens + max_new
-    flops = batch * (
+    return batch * (
         asr_flops_per_example(asr_config, n_frames, max_tokens)
         + transformer_flops_per_token(lm_config, lm_tokens) * lm_tokens
         + detector_flops_per_image(det_config))
+
+
+_MULTIMODAL_STAGES = ("whisper_small -> (text, llama32_1b decode -> "
+                      "reply text) + yolov8n-640 -> detections")
+_MULTIMODAL_STAGES_SMOKE = ("speech->(text,lm decode) + "
+                            "vision->detections (smoke)")
+
+
+def bench_multimodal(peak):
+    """BASELINE config 5 at the NAMED reference-scale stages: the
+    whisper_small ASR preset, the llama32_1b LM, and the yolov8n 640 px
+    detector -- the same model configs benched individually as configs
+    2/3/4 (SMOKE shrinks everything for CPU runs).  Each frame carries
+    `batch` audio windows + images; micro_batch coalesces queued frames
+    into one jit call per stage.  This is the THROUGHPUT operating
+    point; the `latency` config runs the same graph at rows 2 / micro 1
+    / window 1 (the two ends of the frontier)."""
+    warmup, measure = (2, 8) if SMOKE else (10, 120)
+    # 5 s chunks = the reference speech cadence (audio_io.py:455-460)
+    audio_seconds = 1.0 if SMOKE else 5.0
+    # rows per frame (data_batch_size) x frames coalesced per jit call;
+    # env-tunable for scaling experiments.  Measured on v5e round 5
+    # (after the jitted coalesce program landed): rows 16 / micro 8 /
+    # window 64 -> 18.95 fps, MFU 0.263; micro 4 -> 10.7 fps / 0.149;
+    # rows 24 collapsed to 3.2 fps (compile-bound) and micro 16
+    # (batch-256 stages) stalled the 900 s response timeout compiling
+    batch = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_ROWS", "16"))
+    micro = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_MICRO", "8"))
+    max_tokens = 16
+    # the LM stage DECODES (greedy, one jit: prefill + fori_loop), the
+    # reference's chat semantics (elements_llm.py:181-210) -- not a
+    # scoring pass
+    max_new = 8 if SMOKE else int(os.environ.get("AIKO_BENCH_NEW", "32"))
+    definition, asr_config, lm_config, det_config = _multimodal_setup(
+        "bench_multimodal", batch, micro, max_tokens, max_new,
+        audio_seconds, warmup + measure + 4)
+    fps, p50, drain_pf, _ = _run_pipeline(
+        definition, warmup=warmup, measure=measure, ready_key="detections")
+    flops = _multimodal_flops(asr_config, lm_config, det_config, batch,
+                              max_tokens, max_new, audio_seconds)
     return {"frames_per_sec_chip": round(fps, 2),
             **_latency_fields(p50, drain_pf),
             "audio_seconds_per_frame": audio_seconds,
@@ -761,14 +791,48 @@ def bench_multimodal(peak):
             "audio_realtime_factor": round(
                 fps * batch * audio_seconds, 2),
             "tokens_generated_per_frame": batch * max_new,
-            "stages": ("whisper_small -> (text, llama32_1b decode -> "
-                       "reply text) + yolov8n-640 -> detections"
-                       if not SMOKE else
-                       "speech->(text,lm decode) + vision->detections "
-                       "(smoke)"),
+            "stages": (_MULTIMODAL_STAGES if not SMOKE
+                       else _MULTIMODAL_STAGES_SMOKE),
             "micro_batch": micro,
             "mfu": _mfu(fps * flops, peak)}, fps, (p50 + drain_pf), (
                 audio_seconds), batch
+
+
+# -- config 5L: the latency operating point of the same graph ----------------
+
+def bench_latency(peak):
+    """The LATENCY end of the config-5 frontier (VERDICT r5 item 2: the
+    driver metric is throughput AND p50 frame latency, but only the
+    throughput-mode operating point -- 533 ms at micro 8 / window 64 --
+    was on record).  Same graph, rows 2 / micro_batch 1 /
+    frame_window 1: at most ONE frame in flight end-to-end, so p50 is
+    true per-frame service latency (dispatch + graph + host stages),
+    not queueing depth.  Together with config 5 this records the
+    throughput<->latency frontier the serving scheduler can be operated
+    on."""
+    warmup, measure = (2, 6) if SMOKE else (5, 40)
+    audio_seconds = 1.0 if SMOKE else 5.0
+    batch = 1 if SMOKE else 2
+    max_tokens = 16
+    max_new = 8 if SMOKE else 32
+    definition, asr_config, lm_config, det_config = _multimodal_setup(
+        "bench_latency", batch, 1, max_tokens, max_new, audio_seconds,
+        warmup + measure + 4)
+    fps, p50, drain_pf, _ = _run_pipeline(
+        definition, warmup=warmup, measure=measure,
+        ready_key="detections", window=1)
+    flops = _multimodal_flops(asr_config, lm_config, det_config, batch,
+                              max_tokens, max_new, audio_seconds)
+    return {"frames_per_sec_chip": round(fps, 2),
+            **_latency_fields(p50, drain_pf),
+            "audio_seconds_per_frame": audio_seconds,
+            "rows_per_frame": batch,
+            "micro_batch": 1,
+            "frame_window": 1,
+            "operating_point": "latency (one frame in flight)",
+            "stages": (_MULTIMODAL_STAGES if not SMOKE
+                       else _MULTIMODAL_STAGES_SMOKE),
+            "mfu": _mfu(fps * flops, peak)}
 
 
 # -- config 6: many-stream serving (multitude) -------------------------------
@@ -856,8 +920,11 @@ def bench_serving(peak):
     # (speedup 1.95 claimed, 0.37 recorded).  Interleaved repeated
     # trials with ALTERNATING order make order effects and tunnel
     # variance visible as spread instead of silently deciding the
-    # verdict; medians decide the speedup
-    trials = 1 if SMOKE else 3
+    # verdict; medians decide the speedup.  >= 5 trials per arm with
+    # per-trial values PUBLISHED: the round-5 coalesced spread was
+    # [1030, 1896] and min/max alone could not show whether that was
+    # one outlier or a bimodal distribution (VERDICT r5 item 4)
+    trials = 1 if SMOKE else 5
     fps_coalesced, fps_single = [], []
     for trial in range(trials):
         arms = [(micro, fps_coalesced), (1, fps_single)]
@@ -871,9 +938,11 @@ def bench_serving(peak):
     return {
         "streams": streams_n,
         "frames_per_sec_total": round(med_coalesced, 1),
+        "coalesced_trials": [round(value, 1) for value in fps_coalesced],
         "coalesced_spread": [round(min(fps_coalesced), 1),
                              round(max(fps_coalesced), 1)],
         "frames_per_sec_uncoalesced": round(med_single, 1),
+        "uncoalesced_trials": [round(value, 1) for value in fps_single],
         "uncoalesced_spread": [round(min(fps_single), 1),
                                round(max(fps_single), 1)],
         "coalescing_speedup": round(
@@ -951,6 +1020,7 @@ _SUMMARY_FIELDS = (
     ("train", "train_mfu", "train_mfu"),
     ("serving", "coalescing_speedup", "serving_speedup"),
     ("serving", "frames_per_sec_total", "serving_fps"),
+    ("latency", "p50_ms", "latency_p50_ms"),
     ("tts", "mfu", "tts_mfu"),
     ("pipeline_multimodal", "mfu", "headline_mfu"),
     ("pipeline_multimodal", "audio_realtime_factor", "audio_rt"),
@@ -1028,7 +1098,7 @@ def main() -> None:
 
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
-                       "longcontext,serving,tts,pipeline")
+                       "longcontext,serving,latency,tts,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -1048,6 +1118,8 @@ def main() -> None:
         configs["longcontext"] = bench_longcontext(peak)
     if "serving" in wanted:
         configs["serving"] = bench_serving(peak)
+    if "latency" in wanted:
+        configs["latency"] = bench_latency(peak)
     if "tts" in wanted:
         configs["tts"] = bench_tts(peak)
     headline_fps, headline_p50, audio_seconds = None, None, None

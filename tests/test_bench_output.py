@@ -55,10 +55,26 @@ def _fat_detail():
                                                   "prefill_ms": 1940.0,
                                                   "mfu": 0.0647}}},
         "serving": {"streams": 32, "frames_per_sec_total": 591.5,
+                    "coalesced_trials": [591.5, 1030.2, 1895.8, 1766.4,
+                                         1820.9],
+                    "coalesced_spread": [591.5, 1895.8],
                     "frames_per_sec_uncoalesced": 1617.2,
-                    "coalescing_speedup": 0.37, "micro_batch": 16,
+                    "uncoalesced_trials": [1617.2, 1084.3, 1216.9,
+                                           1153.0, 1201.4],
+                    "uncoalesced_spread": [1084.3, 1617.2],
+                    "coalescing_speedup": 0.37, "trials_per_arm": 5,
+                    "micro_batch": 16,
                     "model": "yolov8n 640x640",
                     "vs_reference_broker_ceiling": 11.8, "mfu": 0.0067},
+        "latency": {"frames_per_sec_chip": 11.2, "p50_ms": 96.4,
+                    "p50_arrival_ms": 92.1, "drain_per_frame_ms": 4.3,
+                    "audio_seconds_per_frame": 5.0, "rows_per_frame": 2,
+                    "micro_batch": 1, "frame_window": 1,
+                    "operating_point": "latency (one frame in flight)",
+                    "stages": ("whisper_small -> (text, llama32_1b "
+                               "decode -> reply text) + yolov8n-640 -> "
+                               "detections"),
+                    "mfu": 0.011},
         "tts": {"frames_per_sec_chip": 24.55, "p50_ms": 132.4,
                 "p50_arrival_ms": 1.13, "drain_per_frame_ms": 131.27,
                 "audio_seconds_per_frame": 25.8,

@@ -720,3 +720,141 @@ def test_lm_generate_weight_dtype_int8():
     generated = np.asarray(outputs["generated"])
     assert generated.shape == (1, 6)
     assert ((generated >= 0) & (generated < TINY_LM["vocab_size"])).all()
+
+
+# -- fused whole-group execution on the model stages -------------------------
+
+def _inject_frames(definition, frames, timeout=120):
+    """Queue `frames` before the event loop starts (all park in the
+    micro-batch scheduler), return ({frame_id: outputs}, pipeline)."""
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s", queue_response=responses,
+                                    grace_time=300)
+    for frame_data in frames:
+        pipeline.create_frame(stream, frame_data)
+    process.run(in_thread=True)
+    got = {}
+    for _ in range(len(frames)):
+        _, frame, outputs = responses.get(timeout=timeout)
+        got[frame.frame_id] = outputs
+    process.terminate()
+    return got, pipeline
+
+
+def _tree_equal(left, right):
+    if isinstance(left, dict):
+        assert set(left) == set(right)
+        for key in left:
+            _tree_equal(left[key], right[key])
+        return
+    left = np.asarray(left)
+    right = np.asarray(right)
+    assert left.dtype == right.dtype and left.shape == right.shape
+    np.testing.assert_array_equal(left, right)
+
+
+def test_detector_fused_group_matches_chained():
+    """Detector's group kernel (concat+detect+split as ONE program) must
+    reproduce the chained micro-batch path's detections exactly."""
+
+    def build(fused):
+        return {
+            "name": "fused_det",
+            "graph": ["(detector)"],
+            "elements": [
+                {"name": "detector", "input": [{"name": "image"}],
+                 "output": [{"name": "detections"}],
+                 "parameters": {**TINY_DET, "micro_batch": 4,
+                                "micro_batch_fused": fused},
+                 "deploy": local("Detector")},
+            ],
+        }
+
+    rng = np.random.default_rng(0)
+    frames = [{"image": rng.uniform(
+        0, 1, (1, 3, 32, 32)).astype(np.float32)} for _ in range(3)]
+    fused_got, fused_pipe = _inject_frames(build(True), frames)
+    chained_got, chained_pipe = _inject_frames(build(False), frames)
+    assert fused_pipe._fused_programs and not chained_pipe._fused_programs
+    assert set(fused_got) == set(chained_got)
+    for frame_id in fused_got:
+        _tree_equal(fused_got[frame_id]["detections"],
+                    chained_got[frame_id]["detections"])
+
+
+def test_speech_to_text_fused_group_matches_chained():
+    def build(fused):
+        return {
+            "name": "fused_asr",
+            "graph": ["(asr)"],
+            "elements": [
+                {"name": "asr", "input": [{"name": "audio"}],
+                 "output": [{"name": "tokens"}],
+                 "parameters": {**TINY_ASR, "micro_batch": 4,
+                                "micro_batch_fused": fused},
+                 "deploy": local("SpeechToText")},
+            ],
+        }
+
+    rng = np.random.default_rng(1)
+    frames = [{"audio": rng.standard_normal(
+        (1, 1600)).astype(np.float32)} for _ in range(3)]
+    fused_got, fused_pipe = _inject_frames(build(True), frames)
+    chained_got, _ = _inject_frames(build(False), frames)
+    assert fused_pipe._fused_programs
+    for frame_id in fused_got:
+        _tree_equal(fused_got[frame_id]["tokens"],
+                    chained_got[frame_id]["tokens"])
+
+
+def test_lm_generate_fused_group_matches_chained():
+    def build(fused):
+        return {
+            "name": "fused_lm",
+            "graph": ["(lm)"],
+            "elements": [
+                {"name": "lm", "input": [{"name": "tokens"}],
+                 "output": [{"name": "generated"}],
+                 "parameters": {**TINY_LM, "micro_batch": 4,
+                                "micro_batch_fused": fused,
+                                "max_new_tokens": 4},
+                 "deploy": local("LMGenerate")},
+            ],
+        }
+
+    rng = np.random.default_rng(2)
+    frames = [{"tokens": rng.integers(
+        1, 300, (1, 6), dtype=np.int32)} for _ in range(3)]
+    fused_got, fused_pipe = _inject_frames(build(True), frames)
+    chained_got, _ = _inject_frames(build(False), frames)
+    assert fused_pipe._fused_programs
+    for frame_id in fused_got:
+        _tree_equal(fused_got[frame_id]["generated"],
+                    chained_got[frame_id]["generated"])
+
+
+def test_lm_generate_group_kernel_gated_on_host_work():
+    """Configurations whose process_frame does per-frame host work
+    (tokenizer decode, token streaming) must fall back to the chained
+    path: group_kernel returns None."""
+    definition = {
+        "name": "gated_lm",
+        "graph": ["(lm)"],
+        "elements": [
+            {"name": "lm", "input": [{"name": "text"}],
+             "output": [{"name": "generated"}, {"name": "text"}],
+             "parameters": {**TINY_LM, "tokenizer": "default",
+                            "max_new_tokens": 2},
+             "deploy": local("LMGenerate")},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s", queue_response=responses,
+                                    grace_time=300)
+    assert pipeline.elements["lm"].group_kernel(stream) is None
+    process.terminate()

@@ -358,6 +358,20 @@ class TestMiniMqttClientUnit:
         thread.join(timeout=5.0)
         assert result["ok"] is True
 
+    def test_keepalive_send_failure_rolls_back_ping_count(self):
+        """A keepalive PINGREQ that never hits the wire must not leave a
+        phantom sent-count deficit: later flush() waiters would block on
+        a PINGRESP that was never requested (mirrors flush()'s own
+        rollback)."""
+        class _DeadSock:
+            def sendall(self, data):
+                raise OSError("gone")
+
+        client = minimqtt.Client()
+        client._sock = _DeadSock()
+        client._send_keepalive_ping()
+        assert client._ping_sent == client._ping_acked == 0
+
     def test_flush_aborts_on_connection_loss(self):
         import threading
         import time

@@ -1067,3 +1067,332 @@ def test_micro_batch_capacity_flush_keeps_window_for_other_cohort():
     # full (8 rows)
     assert sorted(stream.variables["batches"]) == [8, 8], stream.variables
     process.terminate()
+
+
+# -- fused whole-group execution ----------------------------------------------
+
+class FusedRecorder(PipelineElement):
+    """Same math on both paths: chained process_frame multiplies by 10
+    (and records the coalesced batch size); group_kernel exposes the
+    identical math as a pure kernel.  kernel_traces counts TRACE-time
+    executions of the kernel body -- one per compiled (names, arity,
+    shapes) signature -- so tests can assert partial groups reuse the
+    steady-state executable."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.kernel_traces = 0
+        self._kernel = None
+
+    def process_frame(self, stream, x):
+        stream.variables.setdefault("batches", []).append(int(x.shape[0]))
+        return StreamEvent.OKAY, {
+            "y": x * 10.0, "nested": {"z": x + 1.0}}
+
+    def group_kernel(self, stream):
+        if self._kernel is None:
+            def kernel(context, x):
+                self.kernel_traces += 1  # runs at trace time only
+                return {"y": x * 10.0, "nested": {"z": x + 1.0}}
+
+            self._kernel = kernel
+        return self._kernel, ()
+
+
+class BrokenKernelRecorder(FusedRecorder):
+    def group_kernel(self, stream):
+        raise RuntimeError("no kernel today")
+
+
+class AsyncWithKernel(AsyncHostElement):
+    def process_async(self, stream, x):
+        return {"y": x}
+
+    def group_kernel(self, stream):
+        return (lambda context, x: {"y": x}), ()
+
+
+def _fused_definition(micro_batch, fused=True,
+                      class_name="FusedRecorder"):
+    return {
+        "name": "fused_pipe",
+        "graph": ["(batcher)"],
+        "elements": [
+            {"name": "batcher", "input": [{"name": "x"}],
+             "output": [{"name": "y"}, {"name": "nested"}],
+             "parameters": {"micro_batch": micro_batch,
+                            "micro_batch_fused": fused},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": class_name}}},
+        ],
+    }
+
+
+def _run_fused_pipe(definition, frames):
+    """Queue `frames` before the event loop starts (all park), return
+    {frame_id: outputs} plus the pipeline for introspection."""
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    for frame_data in frames:
+        pipeline.create_frame(stream, frame_data)
+    process.run(in_thread=True)
+    got = {}
+    for _ in range(len(frames)):
+        _, frame, outputs = responses.get(timeout=30)
+        got[frame.frame_id] = outputs
+    return got, pipeline, stream, process
+
+
+def test_fused_group_matches_chained_bit_for_bit():
+    """The tentpole correctness gate: the fused concat+kernel+split
+    program must produce byte-identical outputs to the chained
+    jitted-concat -> process_frame -> jitted-split path."""
+    import numpy as np
+    frames = [{"x": np.full((2, 3), float(index), np.float32)}
+              for index in range(6)]
+    fused_got, fused_pipe, fused_stream, p1 = _run_fused_pipe(
+        _fused_definition(micro_batch=4, fused=True), frames)
+    chained_got, _, chained_stream, p2 = _run_fused_pipe(
+        _fused_definition(micro_batch=4, fused=False), frames)
+    assert sorted(fused_got) == sorted(chained_got) == list(range(6))
+    for index in range(6):
+        for key_path in (("y",), ("nested", "z")):
+            fused_value = fused_got[index]
+            chained_value = chained_got[index]
+            for key in key_path:
+                fused_value = fused_value[key]
+                chained_value = chained_value[key]
+            fused_value = np.asarray(fused_value)
+            chained_value = np.asarray(chained_value)
+            assert fused_value.dtype == chained_value.dtype
+            assert fused_value.shape == chained_value.shape
+            assert fused_value.tobytes() == chained_value.tobytes()
+    # the fused arm never entered process_frame; the chained arm did
+    assert "batches" not in fused_stream.variables
+    assert chained_stream.variables["batches"] == [8, 8]
+    assert fused_pipe.elements["batcher"].kernel_traces >= 1
+    p1.terminate()
+    p2.terminate()
+
+
+def test_fused_partial_group_reuses_compilation():
+    """Partial (rampup/drain) groups pad the entry list with fillers to
+    the full micro arity, so the fused program compiles ONCE: a full
+    4-frame group and a later 2-frame partial share the executable."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, _fused_definition(micro_batch=4))
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    for index in range(4):  # full group
+        pipeline.create_frame(
+            stream, {"x": np.full((2, 3), float(index), np.float32)})
+    process.run(in_thread=True)
+    for _ in range(4):
+        responses.get(timeout=30)
+    element = pipeline.elements["batcher"]
+    assert element.kernel_traces == 1
+    # drain tail: 2 frames park and flush as a PARTIAL group
+    for index in range(4, 6):
+        pipeline.create_frame(
+            stream, {"x": np.full((2, 3), float(index), np.float32)})
+    got = {}
+    for _ in range(2):
+        _, frame, outputs = responses.get(timeout=30)
+        got[frame.frame_id] = outputs
+    for index in (4, 5):  # own rows, not a filler's zeros
+        assert float(np.asarray(got[index]["y"])[0, 0]) == index * 10
+    assert element.kernel_traces == 1, (
+        "partial group recompiled instead of reusing the padded arity")
+    process.terminate()
+
+
+def test_fused_falls_back_when_kernel_raises():
+    """A raising group_kernel must degrade to the chained path (frames
+    still complete), not error the stream."""
+    import numpy as np
+    frames = [{"x": np.full((1, 2), float(index), np.float32)}
+              for index in range(3)]
+    got, _, stream, process = _run_fused_pipe(
+        _fused_definition(micro_batch=4,
+                          class_name="BrokenKernelRecorder"), frames)
+    for index in range(3):
+        assert float(np.asarray(got[index]["y"])[0, 0]) == index * 10
+    assert stream.variables["batches"] == [4]  # chained path ran
+    process.terminate()
+
+
+def test_fused_shared_output_not_split():
+    """Ports declared "batched": false arrive whole from the fused
+    program, matching the chained path's shared-output contract."""
+    import numpy as np
+    definition = {
+        "name": "fused_shared",
+        "graph": ["(batcher)"],
+        "elements": [
+            {"name": "batcher", "input": [{"name": "x"}],
+             "output": [{"name": "y"},
+                        {"name": "affinity", "batched": False}],
+             "parameters": {"micro_batch": 4},
+             "deploy": {"local": {"module": "tests.test_pipeline",
+                                  "class_name": "FusedAffinity"}}},
+        ],
+    }
+    frames = [{"x": np.full((1, 2), float(index), np.float32)}
+              for index in range(4)]
+    got, _, _, process = _run_fused_pipe(definition, frames)
+    for index in range(4):
+        assert np.asarray(got[index]["y"]).shape == (1, 2)
+        assert float(np.asarray(got[index]["y"])[0, 0]) == index * 10
+        # (N, N) matrix with N == coalesced batch arrives WHOLE
+        assert np.asarray(got[index]["affinity"]).shape == (4, 4)
+    process.terminate()
+
+
+class FusedAffinity(PipelineElement):
+    def process_frame(self, stream, x):
+        raise AssertionError("fused path must not call process_frame")
+
+    def group_kernel(self, stream):
+        import jax.numpy as jnp
+
+        def kernel(context, x):
+            n = x.shape[0]
+            return {"y": x * 10.0, "affinity": jnp.eye(n)}
+
+        return kernel, ()
+
+
+def test_async_host_element_group_kernel_rejected():
+    """AsyncHostElement work leaves the event loop -- a group kernel on
+    one is a contract violation, rejected at pipeline build time."""
+    definition = _fused_definition(micro_batch=4,
+                                   class_name="AsyncWithKernel")
+    process = Process(transport_kind="loopback")
+    process.run(in_thread=True)
+    with pytest.raises(TypeError, match="group kernel"):
+        create_pipeline(process, definition)
+    process.terminate()
+
+
+class FusedListBatcher(PipelineElement):
+    """Returns a batched output as a per-row Python LIST on both paths
+    (the chained split slices host lists of length == target; the fused
+    split must match)."""
+
+    def process_frame(self, stream, x):
+        return StreamEvent.OKAY, {
+            "rows": [x[index] * 2.0 for index in range(x.shape[0])]}
+
+    def group_kernel(self, stream):
+        if not hasattr(self, "_kernel"):
+            def kernel(context, x):
+                return {"rows": [x[index] * 2.0
+                                 for index in range(x.shape[0])]}
+
+            self._kernel = kernel
+        return self._kernel, ()
+
+
+def test_fused_list_output_sliced_per_frame_like_chained():
+    import numpy as np
+    frames = [{"x": np.full((2, 3), float(index), np.float32)}
+              for index in range(4)]
+
+    def run(fused):
+        definition = _fused_definition(micro_batch=4, fused=fused,
+                                       class_name="FusedListBatcher")
+        definition["elements"][0]["output"] = [{"name": "rows"}]
+        got, _, _, process = _run_fused_pipe(definition, frames)
+        process.terminate()
+        return got
+
+    fused_got = run(True)
+    chained_got = run(False)
+    for index in range(4):
+        for arm_got in (fused_got, chained_got):
+            rows = arm_got[index]["rows"]
+            assert len(rows) == 2  # own per-row slice, not all 8
+            assert float(np.asarray(rows[0])[0]) == index * 2
+        for fused_row, chained_row in zip(fused_got[index]["rows"],
+                                          chained_got[index]["rows"]):
+            assert (np.asarray(fused_row).tobytes()
+                    == np.asarray(chained_row).tobytes())
+
+
+class TwoKernelRecorder(FusedRecorder):
+    """One cached kernel PER value of the per-stream "mode" parameter
+    (the SpeechToText/LMGenerate caching shape): alternating cohorts
+    must not evict each other's fused programs."""
+
+    def group_kernel(self, stream):
+        mode = int(self.get_parameter("mode", 1, stream))
+        kernels = getattr(self, "_kernels", None)
+        if kernels is None:
+            kernels = self._kernels = {}
+        kernel = kernels.get(mode)
+        if kernel is None:
+            def kernel(context, x, _mode=mode):
+                self.kernel_traces += 1  # trace-time only
+                return {"y": x * (10.0 * _mode),
+                        "nested": {"z": x + 1.0}}
+
+            kernels[mode] = kernel
+        return kernel, ()
+
+
+def test_fused_program_cache_survives_alternating_cohorts():
+    """Two parameter-fingerprint cohorts with DIFFERENT kernels on one
+    element: each keeps its own compiled fused program (per-node dict,
+    not a single slot) -- alternation must not retrace per group."""
+    import numpy as np
+    process = Process(transport_kind="loopback")
+    definition = _fused_definition(micro_batch=2,
+                                   class_name="TwoKernelRecorder")
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    streams = {}
+    for sid, mode in (("m1", 1), ("m2", 2)):
+        streams[sid] = pipeline.create_stream(
+            sid, queue_response=responses,
+            parameters={} if mode == 1 else {"mode": mode})
+    process.run(in_thread=True)
+    element = pipeline.elements["batcher"]
+    for round_index in range(3):  # alternating cohort traffic
+        for sid in ("m1", "m2"):
+            for _ in range(2):
+                pipeline.create_frame(
+                    streams[sid],
+                    {"x": np.full((1, 3), 1.0, np.float32)})
+        for _ in range(4):
+            stream, _, outputs = responses.get(timeout=30)
+            expected = 10.0 if stream.stream_id == "m1" else 20.0
+            assert float(np.asarray(outputs["y"])[0, 0]) == expected
+    # one trace per kernel, not one per group
+    assert element.kernel_traces == 2, element.kernel_traces
+    process.terminate()
+
+
+class MalformedKernelRecorder(FusedRecorder):
+    def group_kernel(self, stream):
+        # contract violation: bare callable instead of (kernel, context)
+        return lambda context, x: {"y": x * 10.0}
+
+
+def test_fused_falls_back_on_malformed_kernel_spec():
+    """A group_kernel returning something other than (kernel, context)
+    must degrade to the chained path -- never strand the parked frames
+    (they are already popped from _micro_pending when the group runs)."""
+    import numpy as np
+    frames = [{"x": np.full((1, 2), float(index), np.float32)}
+              for index in range(3)]
+    got, pipeline, stream, process = _run_fused_pipe(
+        _fused_definition(micro_batch=4,
+                          class_name="MalformedKernelRecorder"), frames)
+    for index in range(3):
+        assert float(np.asarray(got[index]["y"])[0, 0]) == index * 10
+    assert stream.variables["batches"] == [4]  # chained path ran
+    assert not pipeline._fused_programs
+    process.terminate()

@@ -237,3 +237,86 @@ def test_scale_element_math():
     rng = np.random.default_rng(7)
     expected = rng.standard_normal((2, 4), dtype=np.float32) * 10.0 + 1.0
     np.testing.assert_allclose(outputs["tensor"], expected, rtol=1e-5)
+
+
+def test_compute_element_group_kernel_fused_micro_batch():
+    """ComputeElements get fused whole-group execution for free:
+    compute() traces into the scheduler's concat+kernel+split program,
+    outputs match the chained path bit-for-bit, and dynamic parameters
+    still apply live (they ride the traced context, never baked-in
+    constants)."""
+
+    def build(fused):
+        return {
+            "name": "fused_scale",
+            "graph": ["(scale)"],
+            "elements": [
+                {"name": "scale", "input": [{"name": "tensor"}],
+                 "output": [{"name": "tensor"}],
+                 "parameters": {"scale": 3.0, "micro_batch": 4,
+                                "micro_batch_fused": fused},
+                 "deploy": local("JaxScale")},
+            ],
+        }
+
+    def run(fused):
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, build(fused))
+        responses = queue.Queue()
+        stream = pipeline.create_stream("s1", queue_response=responses)
+        for index in range(6):  # queued before the loop: all park
+            pipeline.create_frame(
+                stream,
+                {"tensor": np.full((2, 3), float(index), np.float32)})
+        process.run(in_thread=True)
+        got = {}
+        for _ in range(6):
+            _, frame, outputs = responses.get(timeout=30)
+            got[frame.frame_id] = np.asarray(outputs["tensor"])
+        # live dynamic-parameter update flows through the cached program
+        pipeline.elements["scale"].set_parameter("scale", 5.0)
+        pipeline.create_frame(
+            stream, {"tensor": np.full((2, 3), 7.0, np.float32)})
+        _, _, outputs = responses.get(timeout=30)
+        got["updated"] = np.asarray(outputs["tensor"])
+        fused_used = bool(pipeline._fused_programs)
+        process.terminate()
+        return got, fused_used
+
+    fused_got, fused_used = run(True)
+    chained_got, chained_used = run(False)
+    assert fused_used and not chained_used
+    assert set(fused_got) == set(chained_got)
+    for key in fused_got:
+        assert fused_got[key].tobytes() == chained_got[key].tobytes()
+    assert float(fused_got["updated"][0, 0]) == 35.0  # 7 * updated 5
+
+
+def test_blocking_metrics_element_stays_on_chained_path():
+    """blocking_metrics promises an in-window block_until_ready and the
+    compute_seconds stream variable -- both live in process_frame, so a
+    blocking_metrics element must not be fused-eligible."""
+    definition = {
+        "name": "blocking_scale",
+        "graph": ["(scale)"],
+        "elements": [
+            {"name": "scale", "input": [{"name": "tensor"}],
+             "output": [{"name": "tensor"}],
+             "parameters": {"scale": 3.0, "micro_batch": 4,
+                            "blocking_metrics": True},
+             "deploy": local("JaxScale")},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    for index in range(4):
+        pipeline.create_frame(
+            stream, {"tensor": np.full((1, 3), float(index), np.float32)})
+    process.run(in_thread=True)
+    for _ in range(4):
+        responses.get(timeout=30)
+    assert not pipeline._fused_programs  # chained path
+    assert "scale" in stream.variables.get("compute_seconds", {})
+    process.terminate()
