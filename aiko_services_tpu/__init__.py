@@ -13,6 +13,7 @@
 #   utils/     L0 kernel utilities (sexpr codec, graph, config, logging)
 #   transport/ L1 message transports (loopback broker, MQTT, null)
 #   runtime/   L2-L8 event engine, process, service, actor, share, registrar
+#   observe/   telemetry: metrics registry, frame tracer, live export
 #   pipeline/  L9 pipeline engine: streams, frames, elements, graphs
 #   ops/       TPU ops: attention, mel spectrogram, image, pallas kernels
 #   parallel/  mesh management, sharding specs, collectives, ring attention

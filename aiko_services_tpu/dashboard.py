@@ -18,7 +18,7 @@ from .runtime.share import ServicesCache
 from .utils import generate, get_logger, parse
 
 __all__ = ["DashboardModel", "run_dashboard", "render_snapshot",
-           "register_plugin", "plugin_for"]
+           "register_plugin", "plugin_for", "format_snapshot_lines"]
 
 _LOGGER = get_logger("dashboard")
 
@@ -48,6 +48,57 @@ def _registrar_plugin(model: "DashboardModel") -> list:
 register_plugin("registrar", _registrar_plugin)
 
 
+def _pipeline_plugin(model: "DashboardModel") -> list:
+    """Pipeline detail lines: the telemetry summary the pipeline mirrors
+    into its EC share (observe.PipelineTelemetry.summary) plus stream
+    state -- the at-a-glance serving health row."""
+    share = model.selected_share
+    lines = [f"streams: {share.get('stream_count', '?')}   "
+             f"frames: {share.get('frame_count', '?')}   "
+             f"elements: {share.get('element_count', '?')}"]
+    metrics = share.get("metrics")
+    if isinstance(metrics, dict):
+        lines.append(
+            f"telemetry: frames {metrics.get('frames', 0)}  "
+            f"dropped {metrics.get('dropped', 0)}  "
+            f"errors {metrics.get('errors', 0)}")
+        lines.append(
+            f"groups: fused {metrics.get('fused_groups', 0)}  "
+            f"chained {metrics.get('chained_groups', 0)}  "
+            f"compiles {metrics.get('compiles_fused', 0)}  "
+            f"cohort splits {metrics.get('cohort_splits', 0)}")
+    else:
+        lines.append("telemetry: (no summary yet -- disabled or "
+                     "first interval pending; press m for live metrics)")
+    return lines
+
+
+register_plugin("pipeline", _pipeline_plugin)
+
+
+def format_snapshot_lines(snapshot: dict, limit: int = 40) -> list:
+    """Human-readable lines for one metrics snapshot: counters first
+    (sorted), then histograms as count/mean/max milliseconds."""
+    lines = []
+    for name, value in sorted((snapshot.get("counters") or {}).items()):
+        lines.append(f"{name:40} {value}")
+    for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        lines.append(f"{name:40} {value:g}")
+    for name, hist in sorted((snapshot.get("histograms") or {}).items()):
+        count = hist.get("count", 0)
+        mean = (hist.get("sum", 0.0) / count) if count else 0.0
+        high = hist.get("max", 0.0)
+        # timing histograms (the "_s" naming convention) read in ms;
+        # occupancy/size histograms stay in their own unit
+        if "_s:" in name or name.endswith("_s"):
+            lines.append(f"{name:40} n={count} mean={mean * 1000:.3f}ms "
+                         f"max={high * 1000:.3f}ms")
+        else:
+            lines.append(f"{name:40} n={count} mean={mean:.2f} "
+                         f"max={high:g}")
+    return lines[:limit]
+
+
 class DashboardModel:
     """Transport-facing half, UI-agnostic: the services table, one
     selected service's mirrored share dict, and control actions."""
@@ -64,6 +115,9 @@ class DashboardModel:
         self._log_topic = None
         self.history_lines: list = []
         self._history_topic = None
+        self.metrics_lines: list = []
+        self._metrics_topic = None
+        self._metrics_by_source: dict = {}
 
     def _service_event(self, command, fields) -> None:
         # copy-on-write: the curses thread iterates self.rows concurrently
@@ -86,19 +140,46 @@ class DashboardModel:
             self.process.remove_message_handler(
                 self._log_handler, self._log_topic)
             self._log_topic = None
+        if self._metrics_topic is not None:
+            self.process.remove_message_handler(
+                self._metrics_handler, self._metrics_topic)
+            self._metrics_topic = None
         self.selected = topic_path
         self.selected_share = {}
         self.log_lines = []
+        self.metrics_lines = []
+        self._metrics_by_source = {}
         if topic_path is not None:
             self._consumer = ECConsumer(
                 self.process, self.selected_share, topic_path)
             self._log_topic = f"{topic_path}/log"  # service.topic_log
             self.process.add_message_handler(
                 self._log_handler, self._log_topic)
+            # live telemetry: pipelines publish "(metrics source
+            # snapshot)" here on their metrics_interval
+            self._metrics_topic = f"{topic_path}/metrics"
+            self.process.add_message_handler(
+                self._metrics_handler, self._metrics_topic)
 
     def _log_handler(self, topic, payload) -> None:
         self.log_lines.append(payload)
         del self.log_lines[:-200]
+
+    def _metrics_handler(self, topic, payload) -> None:
+        from .observe.metrics import parse_metrics_payload
+        decoded = parse_metrics_payload(payload)
+        if decoded is None:
+            return
+        source, snapshot = decoded
+        # one topic carries several sources (the pipeline's own
+        # registry + the process-global one): keep the latest per
+        # source and render them as labeled sections
+        self._metrics_by_source[source] = format_snapshot_lines(snapshot)
+        lines = []
+        for source in sorted(self._metrics_by_source):
+            lines.append(f"== {source}")
+            lines.extend(self._metrics_by_source[source])
+        self.metrics_lines = lines
 
     # -- actions (reference dashboard.py:232-235, 368-377) ------------------
 
@@ -197,6 +278,20 @@ def _parse_edit_value(text: str):
     return text
 
 
+def _page_rows(screen, reserved: int = 4, cap: int = 40) -> int:
+    """Visible line budget for a full-screen page: addstr past the
+    window's last row raises curses.error and would kill the UI loop,
+    so clamp to the terminal height (fake screens without getmaxyx get
+    the legacy cap)."""
+    getmaxyx = getattr(screen, "getmaxyx", None)
+    if getmaxyx is None:
+        return cap
+    try:
+        return max(min(cap, getmaxyx()[0] - reserved), 0)
+    except Exception:
+        return cap
+
+
 def _dashboard_ui(model: DashboardModel, screen, curses) -> None:
     """The curses loop, with screen + curses injectable so the
     fake-curses tests drive it end-to-end.  Keys (reference
@@ -206,6 +301,8 @@ def _dashboard_ui(model: DashboardModel, screen, curses) -> None:
         to the selected service's /control, Esc cancels
       h history -- requests the selected registrar's event ring and
         shows it; any key returns to the services page
+      m metrics -- live telemetry page: counters/gauges/histograms from
+        the selected service's metrics topic; any key returns
     """
     curses.curs_set(0)
     screen.nodelay(True)
@@ -218,7 +315,7 @@ def _dashboard_ui(model: DashboardModel, screen, curses) -> None:
         rows = sorted(model.rows.items())
         screen.addstr(0, 0, "aiko_services_tpu dashboard   "
                       "(q quit, up/down select, k kill, e edit, "
-                      "h history, l log)", curses.A_BOLD)
+                      "h history, l log, m metrics)", curses.A_BOLD)
         if edit_buffer is not None:
             screen.addstr(1, 0, f"update> {edit_buffer}", curses.A_BOLD)
         elif status:
@@ -231,12 +328,29 @@ def _dashboard_ui(model: DashboardModel, screen, curses) -> None:
                               curses.A_DIM)
             # newest entries: the handler trims keeping the TAIL, so
             # with >40 buffered lines the head is the stale end
-            for row, line in enumerate(model.history_lines[-40:]):
+            # ([-0:] would be the WHOLE buffer, hence the rows guard)
+            rows_budget = _page_rows(screen)
+            for row, line in enumerate(
+                    model.history_lines[-rows_budget:]
+                    if rows_budget else []):
                 screen.addstr(row + 3, 0, str(line)[:120])
         elif page == "log":
             screen.addstr(2, 0, f"log: {model.selected or '-'}",
                           curses.A_BOLD)
-            for row, line in enumerate(model.log_lines[-40:]):
+            rows_budget = _page_rows(screen)
+            for row, line in enumerate(
+                    model.log_lines[-rows_budget:]
+                    if rows_budget else []):
+                screen.addstr(row + 3, 0, str(line)[:120])
+        elif page == "metrics":
+            screen.addstr(2, 0, f"metrics: {model.selected or '-'}",
+                          curses.A_BOLD)
+            if not model.metrics_lines:
+                screen.addstr(3, 0, "(waiting for a metrics publish -- "
+                              "pipelines export every metrics_interval)",
+                              curses.A_DIM)
+            for row, line in enumerate(
+                    model.metrics_lines[:_page_rows(screen)]):
                 screen.addstr(row + 3, 0, str(line)[:120])
         else:
             for row, (topic_path, fields) in enumerate(rows[:30]):
@@ -287,7 +401,7 @@ def _dashboard_ui(model: DashboardModel, screen, curses) -> None:
             continue
         if key == ord("q"):
             return
-        if page in ("history", "log"):
+        if page in ("history", "log", "metrics"):
             page = "services"  # any key returns
             continue
         if key == curses.KEY_DOWN:
@@ -303,3 +417,5 @@ def _dashboard_ui(model: DashboardModel, screen, curses) -> None:
             page = "history"
         elif key == ord("l") and model.selected:
             page = "log"
+        elif key == ord("m") and model.selected:
+            page = "metrics"

@@ -378,6 +378,11 @@ class LMGenerate(ComputeElement):
         decode, token streaming, sequence-parallel padding, meshed
         placement."""
         from ..utils import truthy
+        if type(self).process_frame is not LMGenerate.process_frame:
+            # a subclass overriding process_frame (host postprocessing)
+            # must not have its override silently bypassed by the
+            # inherited fused kernel (mirrors the ComputeElement guard)
+            return None
         self._ensure_ready()  # configure(): config + tokenizer exist
         if (self.mesh is not None or self.config.sequence_parallel
                 or self.tokenizer is not None
@@ -502,6 +507,8 @@ class SpeechToText(ComputeElement):
         max_tokens is a compile-time loop bound, so kernels cache per
         resolved value (stable identity keeps the scheduler's compiled
         program cached)."""
+        if type(self).process_frame is not SpeechToText.process_frame:
+            return None  # subclass override must run, not be bypassed
         if self.mesh is not None:
             return None  # meshed inputs need host-side placement
         self._ensure_ready()
@@ -756,6 +763,8 @@ class Detector(ComputeElement):
         the scheduler runs concat+detect+split as ONE program (the
         round-5 standalone probe: 1 642 frames/s fused vs 1 403 for the
         three-dispatch chain on this serving path)."""
+        if type(self).process_frame is not Detector.process_frame:
+            return None  # subclass override must run, not be bypassed
         if self.mesh is not None:
             return None  # meshed inputs need host-side placement
         self._ensure_ready()

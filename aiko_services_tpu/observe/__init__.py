@@ -1,0 +1,13 @@
+# observe/: pipeline telemetry -- metrics registry, frame tracing, and
+# live export over the control plane (see ISSUE 2 / README
+# "Observability").  Layerless by design: metrics.py and trace.py are
+# stdlib-only so any layer (transport, transfer plane, elements) can
+# record without import cycles; telemetry.py is the pipeline engine's
+# glue and the only module that knows what a Pipeline is.
+
+from .metrics import (                                      # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, get_registry,
+    merge_snapshots, snapshot_from_wire)
+from .trace import (                                        # noqa: F401
+    FrameTrace, Tracer, chrome_trace_document)
+from .telemetry import PipelineTelemetry                    # noqa: F401

@@ -1,0 +1,274 @@
+# Metrics registry: counters, gauges, and log-bucketed histograms with
+# MERGEABLE snapshots, serialized over the existing S-expression / EC
+# machinery.
+#
+# No reference counterpart -- the reference's only observability is the
+# log-topic Recorder (reference: src/aiko_services/main/recorder.py:50-96)
+# and ad-hoc per-frame timing floats.  Here every hot-path instrument is a
+# first-class metric a Recorder, dashboard, or bench harness can consume
+# live, and snapshots from MANY processes merge associatively into one
+# fleet view (Prometheus-style, but carried by the framework's own
+# control plane instead of an HTTP scrape).
+#
+# Cost contract (the pipeline instruments its per-frame hot path with
+# these): Counter.inc is one int add, Gauge.set one assignment, and
+# Histogram.record one bisect into a precomputed geometric ladder --
+# nothing allocates, nothing locks (GIL-racy increments can at worst
+# drop a count; instruments are diagnostics, not ledgers).
+#
+# Wire format: `generate("metrics", [source, snapshot])` -- the snapshot
+# is a nested keyword dict, so it rides any transport the control plane
+# rides and shows up readable in `mosquitto_sub`.  The S-expression
+# parser returns numbers as strings; `snapshot_from_wire` restores the
+# numeric types, making to-wire/from-wire a lossless round trip for the
+# supported value domain.
+
+from __future__ import annotations
+
+from bisect import bisect_left
+
+from ..utils import generate, parse_number
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_registry", "merge_snapshots", "parse_metrics_payload",
+    "snapshot_from_wire",
+]
+
+# Geometric bucket ladder for timing histograms: 10 us doubling up to
+# ~84 s (24 bounds -> 25 buckets with the overflow).  One ladder for
+# every histogram keeps merges trivially associative: identical bounds
+# mean bucket-wise addition, in any grouping.
+DEFAULT_BOUNDS = tuple(1e-5 * (2.0 ** i) for i in range(24))
+
+
+class Counter:
+    """Monotonic event count; .inc(n) is one int add."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, amount: int = 1) -> None:
+        self.value += amount
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value) -> None:
+        self.value = float(value)
+
+
+class Histogram:
+    """Log-bucketed distribution: one bisect per record, fixed storage.
+
+    Snapshots carry (count, sum, min, max, per-bucket counts); two
+    snapshots with the same bounds merge by element-wise addition, so
+    merge is associative and commutative -- partial aggregations from
+    different processes/windows combine in any order."""
+
+    __slots__ = ("bounds", "buckets", "count", "total", "low", "high")
+
+    def __init__(self, bounds=DEFAULT_BOUNDS):
+        self.bounds = tuple(bounds)
+        self.buckets = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.low = None
+        self.high = None
+
+    def record(self, value) -> None:
+        value = float(value)
+        self.buckets[bisect_left(self.bounds, value)] += 1
+        self.count += 1
+        self.total += value
+        if self.low is None or value < self.low:
+            self.low = value
+        if self.high is None or value > self.high:
+            self.high = value
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def snapshot(self) -> dict:
+        return {"count": self.count, "sum": self.total,
+                "min": self.low if self.low is not None else 0.0,
+                "max": self.high if self.high is not None else 0.0,
+                "buckets": list(self.buckets)}
+
+
+def _merge_histogram(left: dict, right: dict) -> dict:
+    left_buckets = list(left.get("buckets") or [])
+    right_buckets = list(right.get("buckets") or [])
+    if len(left_buckets) < len(right_buckets):
+        left_buckets += [0] * (len(right_buckets) - len(left_buckets))
+    for index, value in enumerate(right_buckets):
+        left_buckets[index] += value
+    left_count = left.get("count", 0)
+    right_count = right.get("count", 0)
+    # min/max of an EMPTY side must not poison the merge with its 0.0
+    # placeholder -- an all-empty merge stays at the placeholder
+    if not left_count:
+        low, high = right.get("min", 0.0), right.get("max", 0.0)
+    elif not right_count:
+        low, high = left.get("min", 0.0), left.get("max", 0.0)
+    else:
+        low = min(left.get("min", 0.0), right.get("min", 0.0))
+        high = max(left.get("max", 0.0), right.get("max", 0.0))
+    return {"count": left_count + right_count,
+            "sum": left.get("sum", 0.0) + right.get("sum", 0.0),
+            "min": low, "max": high, "buckets": left_buckets}
+
+
+def merge_snapshots(left: dict, right: dict) -> dict:
+    """Associative merge of two registry snapshots: counters add,
+    gauges last-write-win (right side), histograms add bucket-wise."""
+    counters = dict(left.get("counters") or {})
+    for name, value in (right.get("counters") or {}).items():
+        counters[name] = counters.get(name, 0) + value
+    gauges = dict(left.get("gauges") or {})
+    gauges.update(right.get("gauges") or {})
+    histograms = {name: dict(value) for name, value
+                  in (left.get("histograms") or {}).items()}
+    for name, value in (right.get("histograms") or {}).items():
+        if name in histograms:
+            histograms[name] = _merge_histogram(histograms[name], value)
+        else:
+            histograms[name] = dict(value)
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms}
+
+
+_UNSAFE_NAME_CHARS = set(' \t\r\n()"')
+
+
+def _safe_name(name: str) -> str:
+    """Instrument names become UNQUOTED dict keys on the S-expression
+    wire; whitespace/parens in a name (e.g. an element named with a
+    space) would mis-tokenize the whole snapshot on the consumer side,
+    so they are normalized to '_' at registration."""
+    if any(ch in _UNSAFE_NAME_CHARS for ch in name):
+        return "".join("_" if ch in _UNSAFE_NAME_CHARS else ch
+                       for ch in name)
+    return name
+
+
+class MetricsRegistry:
+    """Named instruments, get-or-create; snapshot() is wire-safe."""
+
+    def __init__(self):
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        instrument = self._counters.get(name)
+        if instrument is None:
+            name = _safe_name(name)
+            instrument = self._counters.get(name)
+            if instrument is None:
+                instrument = self._counters[name] = Counter()
+        return instrument
+
+    def gauge(self, name: str) -> Gauge:
+        instrument = self._gauges.get(name)
+        if instrument is None:
+            name = _safe_name(name)
+            instrument = self._gauges.get(name)
+            if instrument is None:
+                instrument = self._gauges[name] = Gauge()
+        return instrument
+
+    def histogram(self, name: str, bounds=DEFAULT_BOUNDS) -> Histogram:
+        instrument = self._histograms.get(name)
+        if instrument is None:
+            name = _safe_name(name)
+            instrument = self._histograms.get(name)
+            if instrument is None:
+                instrument = self._histograms[name] = Histogram(bounds)
+        return instrument
+
+    def snapshot(self) -> dict:
+        # list() the items: other threads (transfer server, mqtt
+        # network loop) may register a first-time instrument while the
+        # export timer snapshots -- a live-dict iteration would raise
+        # mid-publish and lose the whole interval
+        return {
+            "counters": {name: counter.value for name, counter
+                         in list(self._counters.items())},
+            "gauges": {name: gauge.value for name, gauge
+                       in list(self._gauges.items())},
+            "histograms": {name: histogram.snapshot() for name, histogram
+                           in list(self._histograms.items())},
+        }
+
+    def to_payload(self, source: str) -> str:
+        """One `(metrics source snapshot)` S-expression payload."""
+        return generate("metrics", [source, self.snapshot()])
+
+
+def snapshot_from_wire(value) -> dict:
+    """Restore a parsed wire snapshot's numeric types: the S-expression
+    parser returns atoms as strings and renders empty dicts as empty
+    lists; this walks the structure back to the snapshot() shape."""
+    if not isinstance(value, dict):
+        return {"counters": {}, "gauges": {}, "histograms": {}}
+
+    def section(name):
+        part = value.get(name)
+        return part if isinstance(part, dict) else {}
+
+    counters = {name: int(parse_number(item, 0))
+                for name, item in section("counters").items()}
+    gauges = {name: float(parse_number(item, 0.0))
+              for name, item in section("gauges").items()}
+    histograms = {}
+    for name, item in section("histograms").items():
+        if not isinstance(item, dict):
+            continue
+        buckets = item.get("buckets")
+        histograms[name] = {
+            "count": int(parse_number(item.get("count"), 0)),
+            "sum": float(parse_number(item.get("sum"), 0.0)),
+            "min": float(parse_number(item.get("min"), 0.0)),
+            "max": float(parse_number(item.get("max"), 0.0)),
+            "buckets": [int(parse_number(entry, 0)) for entry in buckets]
+            if isinstance(buckets, list) else [],
+        }
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms}
+
+
+def parse_metrics_payload(payload):
+    """Decode one `(metrics source snapshot)` wire payload into
+    (source, snapshot) -- the ONE definition of the consumer-side
+    contract (Recorder and dashboard both use it).  Returns None for
+    anything that is not a well-formed metrics payload."""
+    from ..utils import parse
+    try:
+        command, parameters = parse(
+            payload if isinstance(payload, (str, bytes))
+            else str(payload))
+    except ValueError:
+        return None
+    if command != "metrics" or len(parameters) < 2:
+        return None
+    return str(parameters[0]), snapshot_from_wire(parameters[1])
+
+
+# Process-global registry: instruments that have no pipeline context
+# (tensor transfer plane, MQTT client) record here; the pipeline's
+# periodic export merges it into the published snapshot.
+_GLOBAL = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _GLOBAL

@@ -1,0 +1,295 @@
+# PipelineTelemetry: the pipeline engine's single observability seam.
+#
+# One object per Pipeline owning a MetricsRegistry + frame Tracer, with
+# every hot-path hook written so the DISABLED state (pipeline parameter
+# `telemetry: false` -- the latency operating point) costs one attribute
+# check and writes ZERO per-frame keys.  Enabled, the hooks keep the
+# legacy `frame.metrics["time_*"]` keys byte-compatible (PE_Metrics and
+# the bench latency math read them) while also feeding histograms,
+# counters, and trace spans.
+#
+# Export: a periodic timer publishes the merged snapshot (pipeline
+# registry + the process-global registry that the transfer plane and
+# MQTT client write into) on `{topic_path}/metrics` -- matched by the
+# Recorder's `{namespace}/+/+/+/metrics` subscription -- and mirrors a
+# compact summary into the pipeline's EC share for dashboards.
+
+from __future__ import annotations
+
+import time
+
+from ..utils import get_logger, truthy
+from .metrics import MetricsRegistry, get_registry
+from .trace import Tracer, now_us, to_us
+
+__all__ = ["PipelineTelemetry"]
+
+_LOGGER = get_logger("telemetry")
+
+DEFAULT_METRICS_INTERVAL = 10.0
+# group-occupancy ladder: frames per coalesced call, not seconds
+OCCUPANCY_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+class PipelineTelemetry:
+    def __init__(self, pipeline):
+        parameters = pipeline.definition.parameters or {}
+        self.enabled = truthy(parameters.get("telemetry", True))
+        self.pipeline = pipeline
+        self.registry = MetricsRegistry()
+        try:
+            ring_size = int(parameters.get("trace_ring", 256))
+        except (TypeError, ValueError):
+            ring_size = 256
+        self.tracer = Tracer(ring_size=ring_size)
+        try:
+            self._interval = float(parameters.get(
+                "metrics_interval", DEFAULT_METRICS_INTERVAL) or 0.0)
+        except (TypeError, ValueError):
+            self._interval = DEFAULT_METRICS_INTERVAL
+        self._timer = None
+        # hot-path instrument handles resolved ONCE: per-frame hooks do
+        # an attribute read + int add / bisect, never a name lookup
+        registry = self.registry
+        self._frames_total = registry.counter("pipeline.frames_total")
+        self._frames_dropped = registry.counter(
+            "pipeline.frames_dropped")
+        self._frames_errored = registry.counter(
+            "pipeline.frames_errored")
+        self._fused_groups = registry.counter("pipeline.fused_groups")
+        self._chained_groups = registry.counter(
+            "pipeline.chained_groups")
+        self._element_hists: dict = {}
+        self._queue_hists: dict = {}
+        if self.enabled and self._interval > 0:
+            self._timer = self._publish_snapshot
+            pipeline.process.event.add_timer_handler(
+                self._timer, self._interval)
+
+    # -- frame lifecycle ---------------------------------------------------
+
+    def frame_begin(self, stream, frame) -> None:
+        if not self.enabled:
+            return
+        frame.trace = self.tracer.begin(stream.stream_id, frame.frame_id)
+
+    def frame_end(self, stream, frame, dropped: bool = False,
+                  error: bool = False) -> None:
+        if not self.enabled:
+            return
+        self._frames_total.inc()
+        if error:
+            self._frames_errored.inc()
+        elif dropped:
+            self._frames_dropped.inc()
+        trace = frame.trace
+        if trace is not None:
+            self.tracer.finish(
+                trace, status=("error" if error
+                               else "dropped" if dropped else "ok"))
+            frame.trace = None
+
+    # -- element execution -------------------------------------------------
+
+    def record_element(self, frame, node: str, start_s: float,
+                       elapsed_s: float, path: str = "inline",
+                       group: int | None = None) -> None:
+        """One element call finished: the legacy time_{node} key, the
+        per-node latency histogram, and a trace span tagged with the
+        dispatch path (inline / fused / chained / async / remote)."""
+        if not self.enabled:
+            return
+        metrics = frame.metrics
+        key = "time_" + node
+        metrics[key] = metrics.get(key, 0.0) + elapsed_s
+        histogram = self._element_hists.get(node)
+        if histogram is None:
+            histogram = self._element_hists[node] = (
+                self.registry.histogram("element_s:" + node))
+        histogram.record(elapsed_s)
+        trace = frame.trace
+        if trace is not None:
+            args = {"path": path}
+            if group is not None:
+                args["group"] = group
+            trace.events.append(
+                ("X", node, "element", to_us(start_s), elapsed_s * 1e6,
+                 args))
+
+    def record_pipeline_pass(self, frame, start_s: float) -> None:
+        if not self.enabled:
+            return
+        frame.metrics["time_pipeline"] = (
+            frame.metrics.get("time_pipeline", 0.0)
+            + time.perf_counter() - start_s)
+
+    # -- parks, queues, resumes --------------------------------------------
+
+    def mark_park(self, frame, node: str, kind: str) -> None:
+        """A branch left the event loop (micro-batch park, async worker,
+        remote hop).  Micro parks also open the queue-wait interval."""
+        if not self.enabled:
+            return
+        trace = frame.trace
+        if trace is None:
+            return
+        trace.instant(f"park:{node}", "park", {"kind": kind})
+        if kind == "micro":
+            trace.mark(node)
+
+    def record_queue_wait(self, frame, node: str) -> None:
+        """Close the park's queue-wait interval at flush time: the span
+        between parking and the coalesced dispatch is scheduler-induced
+        latency, reported apart from device/element time."""
+        if not self.enabled:
+            return
+        trace = frame.trace
+        if trace is None:
+            return
+        start = trace.take_mark(node)
+        if start is None:
+            return
+        wait_s = (now_us() - start) / 1e6
+        key = "time_queue_" + node
+        frame.metrics[key] = frame.metrics.get(key, 0.0) + wait_s
+        histogram = self._queue_hists.get(node)
+        if histogram is None:
+            histogram = self._queue_hists[node] = (
+                self.registry.histogram("queue_s:" + node))
+        histogram.record(wait_s)
+        trace.events.append(
+            ("X", f"queue:{node}", "queue", start, wait_s * 1e6, None))
+
+    def mark_resume(self, frame, node: str,
+                    elapsed_s: float | None = None,
+                    path: str = "async") -> None:
+        """A parked branch resumed (async reply or remote response);
+        `elapsed_s` is the off-loop work time the reply reported and
+        `path` attributes the span (async worker vs remote hop)."""
+        if not self.enabled:
+            return
+        if elapsed_s is not None:
+            key = "time_" + node
+            frame.metrics[key] = frame.metrics.get(key, 0.0) + elapsed_s
+            histogram = self._element_hists.get(node)
+            if histogram is None:
+                histogram = self._element_hists[node] = (
+                    self.registry.histogram("element_s:" + node))
+            histogram.record(elapsed_s)
+        trace = frame.trace
+        if trace is not None:
+            if elapsed_s is not None:
+                trace.events.append(
+                    ("X", node, "element", now_us() - elapsed_s * 1e6,
+                     elapsed_s * 1e6, {"path": path}))
+            trace.instant(f"resume:{node}", "park", None)
+
+    # -- micro-batch scheduler ---------------------------------------------
+
+    def record_group(self, node: str, size: int, rows: int,
+                     fused: bool) -> None:
+        if not self.enabled:
+            return
+        (self._fused_groups if fused else self._chained_groups).inc()
+        self.registry.histogram(
+            f"group_frames:{node}", OCCUPANCY_BOUNDS).record(size)
+        self.registry.histogram(
+            f"group_rows:{node}", OCCUPANCY_BOUNDS).record(rows)
+
+    def record_compile(self, node: str, what: str) -> None:
+        if not self.enabled:
+            return
+        self.registry.counter(f"pipeline.compiles_{what}").inc()
+        self.tracer.instant_global(f"compile:{node}", "compile",
+                                   {"what": what})
+
+    def record_cohort_split(self, node: str, cohorts: int) -> None:
+        if not self.enabled:
+            return
+        self.registry.counter("pipeline.cohort_splits").inc()
+        self.registry.gauge(f"cohorts:{node}").set(cohorts)
+
+    # -- element-side device instruments -----------------------------------
+
+    def record_device(self, node: str, compute_s: float,
+                      block_ready_s: float | None = None) -> None:
+        """ComputeElement device work: host-observed dispatch+compute
+        time, plus the explicit block_until_ready wait when the element
+        runs with blocking_metrics."""
+        if not self.enabled:
+            return
+        self.registry.histogram(f"compute_s:{node}").record(compute_s)
+        if block_ready_s is not None:
+            self.registry.histogram(
+                f"block_ready_s:{node}").record(block_ready_s)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """THIS pipeline's registry only (see process_snapshot)."""
+        return self.registry.snapshot()
+
+    @staticmethod
+    def process_snapshot() -> dict:
+        """The process-global registry (transfer plane, MQTT client).
+        Published under a PROCESS-scoped source name, never merged into
+        a pipeline's snapshot: N pipelines in one process would
+        otherwise each republish the same global counters and the
+        Recorder's fleet merge would count them N times."""
+        return get_registry().snapshot()
+
+    def summary(self) -> dict:
+        """Compact scalars for the EC share / dashboard plugin."""
+        return {
+            "frames": self._frames_total.value,
+            "dropped": self._frames_dropped.value,
+            "errors": self._frames_errored.value,
+            "fused_groups": self._fused_groups.value,
+            "chained_groups": self._chained_groups.value,
+            "compiles_fused": self.registry.counter(
+                "pipeline.compiles_fused").value,
+            "cohort_splits": self.registry.counter(
+                "pipeline.cohort_splits").value,
+        }
+
+    def _publish_snapshot(self) -> None:
+        pipeline = self.pipeline
+        try:
+            from ..utils import generate
+            topic = f"{pipeline.topic_path}/metrics"
+            pipeline.process.publish(
+                topic, generate("metrics",
+                                [pipeline.topic_path, self.snapshot()]))
+            # the process-global registry rides the same topic under an
+            # OS-process-scoped source: every pipeline (and every
+            # framework Process object sharing this interpreter)
+            # republishes it, but consumers key by SOURCE, so it merges
+            # exactly once.  os.getpid(), NOT process.process_id: a
+            # second Process object in one interpreter gets a "-1"
+            # suffixed id while sharing the SAME global registry
+            import os
+            pipeline.process.publish(
+                topic, generate("metrics", [
+                    f"{pipeline.process.namespace}/"
+                    f"{pipeline.process.hostname}/{os.getpid()}/process",
+                    self.process_snapshot()]))
+            if pipeline.ec_producer is not None:
+                pipeline.ec_producer.update("metrics", self.summary())
+        except Exception as error:  # export must never kill the engine
+            _LOGGER.warning("metrics publish failed: %s", error)
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self.pipeline.process.event.remove_timer_handler(self._timer)
+            self._timer = None
+            self._publish_snapshot()  # final flush: no stale last-window
+
+    # -- trace export ------------------------------------------------------
+
+    def chrome_events(self) -> list:
+        return self.tracer.chrome_events(
+            process_name=f"pipeline:{self.pipeline.name}")
+
+    def export_trace(self, path: str) -> int:
+        return self.tracer.export(
+            path, process_name=f"pipeline:{self.pipeline.name}")

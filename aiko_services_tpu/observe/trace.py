@@ -1,0 +1,199 @@
+# Frame tracer: Dapper-style per-frame traces exported as Chrome-trace
+# JSON (Perfetto-loadable).
+#
+# A trace id is minted per frame at stream ingress; the pipeline engine
+# appends span records (element execution, queue wait, fused vs chained
+# dispatch, park/resume, compile events) to the frame's FrameTrace as the
+# frame moves through the graph.  Completed traces land in a bounded ring;
+# export renders them as Chrome trace-event JSON ("X" complete events for
+# spans, "i" instants for point events, "M" metadata naming the process
+# and one thread lane per stream), which chrome://tracing and Perfetto
+# both load directly.
+#
+# Cost contract: when tracing is disabled the frame carries trace=None
+# and every hook is a single `is None` check; when enabled, a span is one
+# perf_counter read and one tuple append -- no dict churn on the hot
+# path, events materialize only at export.
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from collections import deque
+
+__all__ = ["FrameTrace", "Tracer", "chrome_trace_document"]
+
+# One clock epoch per process: every span timestamp is microseconds since
+# this moment, so spans from different streams/elements line up on one
+# export timeline.
+_EPOCH = time.perf_counter()
+
+
+def now_us() -> float:
+    return (time.perf_counter() - _EPOCH) * 1e6
+
+
+def to_us(perf_counter_s: float) -> float:
+    """A raw time.perf_counter() reading on the export timeline."""
+    return (perf_counter_s - _EPOCH) * 1e6
+
+
+class FrameTrace:
+    """Span accumulator for ONE frame: rides Frame.trace through the
+    graph.  `marks` holds open interval starts (queue parks) keyed by
+    node; `events` holds finished records as tuples
+    (kind, name, category, ts_us, dur_us, args).  The frame's own
+    top-level span is NOT an event -- it is built at export from
+    start/end/status, keeping the per-frame hot path to appends."""
+
+    __slots__ = ("pid", "seq", "stream_id", "frame_id", "start_us",
+                 "end_us", "status", "events", "marks")
+
+    def __init__(self, pid: int, seq: int, stream_id: str,
+                 frame_id: int):
+        self.pid = pid
+        self.seq = seq
+        self.stream_id = stream_id
+        self.frame_id = frame_id
+        self.start_us = now_us()
+        self.end_us = None
+        self.status = "ok"
+        self.events: list = []
+        self.marks: dict | None = None  # lazily built on first park
+
+    @property
+    def trace_id(self) -> str:
+        # formatted on demand: minting a frame costs no string build
+        return f"{self.pid:x}-{self.seq:x}"
+
+    def span(self, name: str, category: str, start_us: float,
+             args: dict | None = None) -> None:
+        self.events.append(("X", name, category, start_us,
+                            now_us() - start_us, args))
+
+    def instant(self, name: str, category: str,
+                args: dict | None = None) -> None:
+        self.events.append(("i", name, category, now_us(), 0.0, args))
+
+    def mark(self, key: str) -> None:
+        if self.marks is None:
+            self.marks = {}
+        self.marks[key] = now_us()
+
+    def take_mark(self, key: str) -> float | None:
+        if not self.marks:
+            return None
+        return self.marks.pop(key, None)
+
+
+class Tracer:
+    """Mints trace ids, keeps a bounded ring of completed frame traces,
+    and renders Chrome-trace documents.  Global (non-frame) events --
+    fused-program compiles, scheduler decisions -- accumulate in their
+    own bounded list and export on a dedicated lane."""
+
+    _pids = itertools.count()
+
+    def __init__(self, ring_size: int = 256, pid: int | None = None):
+        self._ids = itertools.count(1)
+        # synthetic per-tracer pid: several pipelines' traces merged
+        # into ONE file stay distinct processes in the Perfetto UI
+        self._pid = (pid if pid is not None
+                     else os.getpid() * 100 + next(Tracer._pids) % 100)
+        self.completed: deque = deque(maxlen=ring_size)
+        self.global_events: deque = deque(maxlen=1024)
+        self._stream_lanes: dict[str, int] = {}
+        # frames evicted from the bounded ring: exports surface this so
+        # a truncated artifact never silently reads as full coverage
+        self.dropped = 0
+
+    def begin(self, stream_id: str, frame_id: int) -> FrameTrace:
+        return FrameTrace(self._pid, next(self._ids), stream_id,
+                          frame_id)
+
+    def finish(self, trace: FrameTrace, status: str = "ok") -> None:
+        trace.end_us = now_us()
+        trace.status = status
+        if len(self.completed) == self.completed.maxlen:
+            self.dropped += 1
+        self.completed.append(trace)
+
+    def instant_global(self, name: str, category: str,
+                       args: dict | None = None) -> None:
+        self.global_events.append(("i", name, category, now_us(), 0.0,
+                                   args))
+
+    def _lane(self, stream_id: str) -> int:
+        lane = self._stream_lanes.get(stream_id)
+        if lane is None:
+            lane = self._stream_lanes[stream_id] = (
+                len(self._stream_lanes) + 1)
+        return lane
+
+    def chrome_events(self, process_name: str = "pipeline") -> list:
+        """All completed traces + global events as Chrome trace-event
+        dicts.  One pid per tracer, one tid lane per stream (lane 0 is
+        the global/scheduler lane), metadata events name both."""
+        events = [
+            {"ph": "M", "name": "process_name", "pid": self._pid,
+             "tid": 0, "args": {"name": process_name}},
+            {"ph": "M", "name": "thread_name", "pid": self._pid,
+             "tid": 0, "args": {"name": "scheduler"}},
+        ]
+        if self.dropped:
+            events.append(self._event(
+                "i", f"trace ring dropped {self.dropped} frames",
+                "truncation", now_us(), 0.0,
+                {"dropped_frames": self.dropped,
+                 "ring_size": self.completed.maxlen}, tid=0))
+        for kind, name, category, ts, dur, args in self.global_events:
+            events.append(self._event(kind, name, category, ts, dur,
+                                      args, tid=0))
+        named_lanes = set()
+        for trace in list(self.completed):
+            lane = self._lane(trace.stream_id)
+            if lane not in named_lanes:
+                named_lanes.add(lane)
+                events.append(
+                    {"ph": "M", "name": "thread_name", "pid": self._pid,
+                     "tid": lane,
+                     "args": {"name": f"stream {trace.stream_id}"}})
+            end_us = (trace.end_us if trace.end_us is not None
+                      else now_us())
+            events.append(self._event(
+                "X", f"frame {trace.frame_id}", "frame", trace.start_us,
+                end_us - trace.start_us,
+                {"trace_id": trace.trace_id, "status": trace.status,
+                 "stream": trace.stream_id}, tid=lane))
+            for kind, name, category, ts, dur, args in trace.events:
+                merged = {"trace_id": trace.trace_id,
+                          "frame_id": trace.frame_id}
+                if args:
+                    merged.update(args)
+                events.append(self._event(kind, name, category, ts, dur,
+                                          merged, tid=lane))
+        return events
+
+    def _event(self, kind, name, category, ts, dur, args, tid) -> dict:
+        event = {"ph": kind, "name": name, "cat": category,
+                 "ts": round(ts, 3), "pid": self._pid, "tid": tid,
+                 "args": args or {}}
+        if kind == "X":
+            event["dur"] = round(dur, 3)
+        if kind == "i":
+            event["s"] = "t"  # instant scope: thread
+        return event
+
+    def export(self, path: str, process_name: str = "pipeline") -> int:
+        """Write a Perfetto-loadable trace file; returns event count."""
+        document = chrome_trace_document(
+            self.chrome_events(process_name=process_name))
+        with open(path, "w") as handle:
+            json.dump(document, handle)
+        return len(document["traceEvents"])
+
+
+def chrome_trace_document(events: list) -> dict:
+    return {"traceEvents": list(events), "displayTimeUnit": "ms"}

@@ -21,8 +21,10 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import time
 
+from ..observe import PipelineTelemetry
 from ..runtime import Actor, Lease, ServiceFilter, ServicesCache
 from ..runtime.service import SERVICE_PROTOCOL_PIPELINE
 from ..utils import generate, get_logger, load_module
@@ -208,6 +210,10 @@ class Pipeline(Actor):
             "stream_count": 0,
             "frame_count": 0,
         })
+        # telemetry: metrics registry + frame tracer + periodic export
+        # (pipeline parameter "telemetry: false" disables ALL per-frame
+        # instrument writes -- the latency operating point)
+        self.telemetry = PipelineTelemetry(self)
         self._produced_keys = self._compute_produced_keys()
         self._create_elements()
         self._update_lifecycle()
@@ -439,6 +445,9 @@ class Pipeline(Actor):
             stream.pending += 1
         frame = Frame(frame_id=frame_id, swag=dict(frame_data))
         stream.frames[frame_id] = frame
+        # stream ingress: mint the frame's trace id (spans accumulate on
+        # the frame as it moves through the graph)
+        self.telemetry.frame_begin(stream, frame)
         self._run_frame(stream, frame, resume_after=None)
 
     def process_frame_response(self, stream_dict, frame_data=None) -> None:
@@ -543,10 +552,11 @@ class Pipeline(Actor):
             # (remote hops apply map_out on the serving side)
             outputs = self._map_out(outputs, element.definition)
         elapsed = stream_dict.get("time")
-        if elapsed is not None:
-            frame.metrics[f"time_{resumed_node}"] = (
-                frame.metrics.get(f"time_{resumed_node}", 0.0)
-                + float(elapsed))
+        self.telemetry.mark_resume(
+            frame, resumed_node,
+            float(elapsed) if elapsed is not None else None,
+            path=("remote" if isinstance(element, RemoteElement)
+                  else "async"))
         frame.swag.update(outputs)
         frame.pending_nodes.discard(resumed_node)
         if frame.paused_pe_name == resumed_node:
@@ -613,6 +623,7 @@ class Pipeline(Actor):
                 frame.paused_pe_name = node_name
                 frame.pending_nodes.add(node_name)
                 frame.had_remote_park = True
+                self.telemetry.mark_park(frame, node_name, kind="remote")
                 element.call("process_frame", [
                     {"stream_id": stream.stream_id,
                      "frame_id": frame.frame_id,
@@ -633,9 +644,9 @@ class Pipeline(Actor):
             element_start = time.perf_counter()
             stream_event, outputs = self._safe_call(
                 element.process_frame, stream, **inputs)
-            frame.metrics[f"time_{node_name}"] = (
-                frame.metrics.get(f"time_{node_name}", 0.0)
-                + time.perf_counter() - element_start)
+            self.telemetry.record_element(
+                frame, node_name, element_start,
+                time.perf_counter() - element_start, path="inline")
             if stream_event == StreamEvent.OKAY:
                 frame.executed.add(node_name)
                 frame.swag.update(self._map_out(outputs or {}, definition))
@@ -651,6 +662,7 @@ class Pipeline(Actor):
                 if frame.paused_pe_name is None:
                     frame.paused_pe_name = node_name
                 frame.pending_nodes.add(node_name)
+                self.telemetry.mark_park(frame, node_name, kind="async")
             elif stream_event == StreamEvent.DROP_FRAME:
                 self._finish_frame(stream, frame, dropped=True)
                 return
@@ -668,9 +680,7 @@ class Pipeline(Actor):
                 self.destroy_stream(stream.stream_id,
                                     state=StreamState.ERROR)
                 return
-        frame.metrics["time_pipeline"] = (
-            frame.metrics.get("time_pipeline", 0.0)
-            + time.perf_counter() - time_start)
+        self.telemetry.record_pipeline_pass(frame, time_start)
         if frame.pending_nodes:
             return  # parked branches resume this pass later
         self._finish_frame(stream, frame)
@@ -754,6 +764,8 @@ class Pipeline(Actor):
         pending = self._micro_pending.setdefault(node_name, [])
         frame.pending_nodes.add(node_name)
         pending.append((stream, frame, inputs, signature))
+        # opens the queue-wait interval (closed at coalesced dispatch)
+        self.telemetry.mark_park(frame, node_name, kind="micro")
         # capacity counts THIS signature only: mixed-signature traffic
         # (stream cohorts with different shapes or parameters) must not
         # trigger a flush that chronically splits every cohort into
@@ -834,6 +846,35 @@ class Pipeline(Actor):
         element = self.elements.get(node_name)
         if element is None or isinstance(element, RemoteElement):
             return
+        if self.telemetry.enabled or (
+                node_name not in self._micro_cohort_logged
+                and _LOGGER.isEnabledFor(logging.DEBUG)):
+            # only scan when someone consumes the result: the counter
+            # (telemetry on) or the one-time debug log -- with
+            # telemetry disabled and debug off the flush path stays
+            # scan-free (the latency operating point's cost contract)
+            # same shapes but different parameter fingerprints: streams
+            # that cannot share a call.  ONE split event per flush (the
+            # widest shape's cohort count), counted so operators watch
+            # the rate live; said once (debug) so the log shows WHY
+            # coalesced groups came up small instead of it degrading
+            # silently
+            fingerprints_by_shape: dict = {}
+            for entry in pending:
+                fingerprints_by_shape.setdefault(
+                    entry[3][0], set()).add(entry[3][1])
+            cohorts = max((len(prints) for prints
+                           in fingerprints_by_shape.values()), default=0)
+            if cohorts > 1:
+                self.telemetry.record_cohort_split(node_name, cohorts)
+                if node_name not in self._micro_cohort_logged:
+                    self._micro_cohort_logged.add(node_name)
+                    _LOGGER.debug(
+                        "%s: %s parked frames split into %d "
+                        "parameter-fingerprint cohorts (streams resolve "
+                        "parameters differently, so cross-stream "
+                        "coalescing runs smaller groups)",
+                        self.name, node_name, cohorts)
         # gather-by-signature, FIFO by first occurrence: interleaved
         # streams with matching shapes+parameters coalesce; a
         # mismatched head never blocks later matching entries.  micro
@@ -851,22 +892,6 @@ class Pipeline(Actor):
                 else:
                     rest.append(entry)
             pending = rest
-            if node_name not in self._micro_cohort_logged:
-                # same shapes but different parameter fingerprints:
-                # streams that cannot share a call.  Said once (debug)
-                # so operators see why coalesced groups came up small
-                # instead of it degrading silently
-                other_cohorts = {entry[3][1] for entry in rest
-                                 if entry[3][0] == signature[0]
-                                 and entry[3][1] != signature[1]}
-                if other_cohorts:
-                    self._micro_cohort_logged.add(node_name)
-                    _LOGGER.debug(
-                        "%s: %s parked frames split into %d "
-                        "parameter-fingerprint cohorts (streams resolve "
-                        "parameters differently, so cross-stream "
-                        "coalescing runs smaller groups)",
-                        self.name, node_name, 1 + len(other_cohorts))
             # frames finished elsewhere / destroyed streams: never resume
             group = [
                 entry for entry in group
@@ -915,6 +940,13 @@ class Pipeline(Actor):
         # guarantee every stream in the group resolves its parameters
         # identically, so the choice is immaterial)
         lead_stream.current_frame_id = group[0][1].frame_id
+        # coalesced dispatch: close every member's queue-wait interval
+        # (park -> here is scheduler-induced latency, reported apart
+        # from element/device time) and record the group shape
+        for _, parked_frame, _, _ in group:
+            self.telemetry.record_queue_wait(parked_frame, node_name)
+        self.telemetry.record_group(node_name, len(group), target,
+                                    fused=kernel_spec is not None)
         per_frame = None
         element_start = time.perf_counter()
         if kernel_spec is not None:
@@ -957,8 +989,10 @@ class Pipeline(Actor):
                 if (self.streams.get(stream.stream_id) is not stream
                         or stream.frames.get(frame.frame_id) is not frame):
                     continue  # finished/destroyed meanwhile
-                frame.metrics[f"time_{node_name}"] = (
-                    frame.metrics.get(f"time_{node_name}", 0.0) + share)
+                self.telemetry.record_element(
+                    frame, node_name, element_start, share,
+                    path=("fused" if kernel_spec is not None
+                          else "chained"), group=len(group))
                 frame.swag.update(self._map_out(frame_outputs,
                                                 element.definition))
                 frame.pending_nodes.discard(node_name)
@@ -970,8 +1004,10 @@ class Pipeline(Actor):
             # each on its own stream
             for stream, frame, _, _ in group:
                 frame.pending_nodes.discard(node_name)
-                frame.metrics[f"time_{node_name}"] = (
-                    frame.metrics.get(f"time_{node_name}", 0.0) + share)
+                self.telemetry.record_element(
+                    frame, node_name, element_start, share,
+                    path=("fused" if kernel_spec is not None
+                          else "chained"), group=len(group))
             if stream_event == StreamEvent.DROP_FRAME:
                 for stream, frame, _, _ in group:
                     self._finish_frame(stream, frame, dropped=True)
@@ -1128,6 +1164,9 @@ class Pipeline(Actor):
             # must not leak one dead program per group
             programs.clear()
         programs[id(kernel)] = (kernel, fused)
+        # a fresh fused program means a fresh XLA compile per signature
+        # underneath: counted + traced so compile storms are attributable
+        self.telemetry.record_compile(node_name, "fused")
         return fused
 
     def _split_micro_outputs_all(self, outputs: dict, rows: list,
@@ -1276,6 +1315,8 @@ class Pipeline(Actor):
         if stream.pending > 0:
             stream.pending -= 1
         self._frame_count += 1
+        self.telemetry.frame_end(stream, frame, dropped=dropped,
+                                 error=error)
         if stream.stop_requested and stream.pending == 0:
             self.destroy_stream(stream.stream_id)
         if not dropped and not error:
@@ -1400,6 +1441,7 @@ class Pipeline(Actor):
         return metadata
 
     def stop(self) -> None:
+        self.telemetry.stop()  # final snapshot publish + timer teardown
         for stream_id in list(self.streams):
             self.destroy_stream(stream_id)
         if self._services_cache is not None:

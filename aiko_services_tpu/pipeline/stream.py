@@ -66,6 +66,10 @@ class Frame:
     # then be delayed duplicates of the remote's, so they are never
     # auto-routed to a local park
     had_remote_park: bool = False
+    # per-frame trace (observe.FrameTrace) minted at stream ingress when
+    # pipeline telemetry is enabled; None otherwise (every tracing hook
+    # is then a single is-None check)
+    trace: object = None
 
 
 @dataclass

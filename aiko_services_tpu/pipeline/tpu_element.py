@@ -306,8 +306,17 @@ class ComputeElement(PipelineElement):
                     f"elements with map_in/map_out)") from error
             raise
         outputs = self._unpad(outputs, placed, padding)
+        block_elapsed = None
         if self._blocking_metrics:
+            block_start = time.perf_counter()
             outputs = jax.block_until_ready(outputs)
-        stream.variables.setdefault("compute_seconds", {})[
-            self.definition.name] = time.perf_counter() - host_start
+            block_elapsed = time.perf_counter() - block_start
+        elapsed = time.perf_counter() - host_start
+        telemetry = getattr(self.pipeline, "telemetry", None)
+        if telemetry is None or telemetry.enabled:
+            stream.variables.setdefault("compute_seconds", {})[
+                self.definition.name] = elapsed
+        if telemetry is not None:
+            telemetry.record_device(self.definition.name, elapsed,
+                                    block_elapsed)
         return StreamEvent.OKAY, outputs

@@ -37,6 +37,8 @@ import uuid
 
 import numpy as np
 
+from ..observe.metrics import get_registry
+
 __all__ = [
     "TensorTransferServer", "TransferError", "fetch",
     "get_transfer_server", "transfer_enabled", "transfer_threshold",
@@ -155,6 +157,9 @@ class TensorTransferServer:
         key = uuid.uuid4().hex
         with self._lock:
             self._store[key] = (time.monotonic() + self.ttl, array)
+        metrics = get_registry()
+        metrics.counter("transfer.offers").inc()
+        metrics.counter("transfer.offered_bytes").inc(array.nbytes)
         self._purge()
         return {"host": self.host, "port": self.port, "key": key,
                 "dtype": str(array.dtype), "shape": list(array.shape)}
@@ -212,6 +217,8 @@ class TensorTransferServer:
                 view = array.tobytes()  # exotic dtypes without buffers
             conn.sendall(_HEADER.pack(array.nbytes))
             conn.sendall(view)
+            get_registry().counter(
+                "transfer.served_bytes").inc(array.nbytes)
         except OSError:
             pass
         finally:
@@ -236,6 +243,8 @@ def fetch(descriptor: dict, timeout: float | None = None) -> np.ndarray:
     if timeout is None:
         timeout = transfer_timeout()
     address = (descriptor["host"], int(descriptor["port"]))
+    metrics = get_registry()
+    fetch_start = time.perf_counter()
     try:
         with socket.create_connection(address, timeout=timeout) as conn:
             conn.settimeout(timeout)
@@ -243,14 +252,20 @@ def fetch(descriptor: dict, timeout: float | None = None) -> np.ndarray:
             header = _recv_exact(conn, _HEADER.size)
             (length,) = _HEADER.unpack(header)
             if length == 0:
+                metrics.counter("transfer.fetch_expired").inc()
                 raise KeyError(
                     f"tensor {descriptor['key']} expired at "
                     f"{address[0]}:{address[1]}")
             raw = _recv_exact(conn, length)
     except OSError as error:
+        metrics.counter("transfer.fetch_errors").inc()
         raise TransferError(
             f"tensor fetch from {address[0]}:{address[1]} failed: "
             f"{error}") from error
+    metrics.counter("transfer.fetches").inc()
+    metrics.counter("transfer.fetched_bytes").inc(length)
+    metrics.histogram("transfer.fetch_s").record(
+        time.perf_counter() - fetch_start)
     array = np.frombuffer(raw, dtype=_resolve_dtype(descriptor["dtype"]))
     return array.reshape(descriptor["shape"])
 

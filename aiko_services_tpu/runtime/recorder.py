@@ -1,15 +1,24 @@
-# Recorder: aggregate distributed log topics for observability.
+# Recorder: aggregate distributed log AND metrics topics for
+# observability.
 #
 # Capability parity with the reference Recorder (reference:
 # src/aiko_services/main/recorder.py:50-96): subscribes to a log-topic
 # wildcard (default "{namespace}/+/+/+/log"), keeps an LRU of per-topic
 # ring buffers, and republishes counts through its ECProducer so dashboards
 # can watch live.
+#
+# Beyond the reference: the Recorder also consumes the telemetry plane --
+# pipelines publish "(metrics source snapshot)" on their
+# "{topic_path}/metrics" topic (observe.PipelineTelemetry); the Recorder
+# keeps the LATEST snapshot per source and merges them associatively into
+# one fleet view (observe.merge_snapshots), so a dashboard or operator
+# asks ONE service for cluster-wide counters/histograms.
 
 from __future__ import annotations
 
 from collections import deque
 
+from ..observe.metrics import merge_snapshots, parse_metrics_payload
 from ..utils import LRUCache, get_logger
 from .actor import Actor
 from .share import ECProducer
@@ -20,21 +29,31 @@ _LOGGER = get_logger("recorder")
 SERVICE_PROTOCOL_RECORDER = "recorder:0"
 RING_SIZE = 128          # reference logger ring, utilities/logger.py:137
 TOPIC_CACHE_SIZE = 64
+METRICS_CACHE_SIZE = 64  # latest snapshot per publishing service
 
 
 class Recorder(Actor):
     def __init__(self, process, name: str = "recorder",
                  log_topic_pattern: str | None = None,
+                 metrics_topic_pattern: str | None = None,
                  ring_size: int = RING_SIZE):
         super().__init__(process, name,
                          protocol=SERVICE_PROTOCOL_RECORDER)
         self.log_topic_pattern = (
             log_topic_pattern or f"{process.namespace}/+/+/+/log")
+        self.metrics_topic_pattern = (
+            metrics_topic_pattern or f"{process.namespace}/+/+/+/metrics")
         self.ring_size = ring_size
         self.topic_rings = LRUCache(TOPIC_CACHE_SIZE)
-        self.share.update({"topic_count": 0, "record_count": 0})
+        self.metrics_snapshots = LRUCache(METRICS_CACHE_SIZE)
+        self.share.update({"topic_count": 0, "record_count": 0,
+                           "metrics_source_count": 0,
+                           "metrics_update_count": 0})
         self._record_count = 0
+        self._metrics_update_count = 0
         self.add_message_handler(self._log_handler, self.log_topic_pattern)
+        self.add_message_handler(self._metrics_handler,
+                                 self.metrics_topic_pattern)
 
     def _log_handler(self, topic: str, payload: str) -> None:
         ring = self.topic_rings.get(topic)
@@ -47,6 +66,22 @@ class Recorder(Actor):
         if self._record_count % 16 == 0:  # rate-limit EC chatter
             self.ec_producer.update("record_count", self._record_count)
 
+    def _metrics_handler(self, topic: str, payload: str) -> None:
+        decoded = parse_metrics_payload(payload)
+        if decoded is None:
+            _LOGGER.debug("undecodable metrics payload on %s", topic)
+            return
+        source, snapshot = decoded
+        new_source = self.metrics_snapshots.get(source) is None
+        self.metrics_snapshots.put(source, snapshot)
+        self._metrics_update_count += 1
+        if new_source:
+            self.ec_producer.update("metrics_source_count",
+                                    len(self.metrics_snapshots))
+        if self._metrics_update_count % 16 == 0:  # rate-limit EC chatter
+            self.ec_producer.update("metrics_update_count",
+                                    self._metrics_update_count)
+
     def records(self, topic: str) -> list:
         ring = self.topic_rings.get(topic)
         return list(ring) if ring is not None else []
@@ -54,7 +89,33 @@ class Recorder(Actor):
     def topics(self) -> list:
         return list(self.topic_rings.keys())
 
+    # -- telemetry views ---------------------------------------------------
+
+    def metrics_sources(self) -> list:
+        return list(self.metrics_snapshots.keys())
+
+    def metrics_for(self, source: str) -> dict | None:
+        return self.metrics_snapshots.get(source)
+
+    def merged_metrics(self) -> dict:
+        """One fleet-wide snapshot: every source's latest, merged
+        (counters add, histograms add bucket-wise)."""
+        merged = {"counters": {}, "gauges": {}, "histograms": {}}
+        for source in self.metrics_snapshots.keys():
+            snapshot = self.metrics_snapshots.get(source)
+            if snapshot:
+                merged = merge_snapshots(merged, snapshot)
+        return merged
+
     def stop(self) -> None:
+        # flush the FINAL record/metrics counts: the modulo-16 rate
+        # limit otherwise leaves the last published value stale by up
+        # to 15 records
+        self.ec_producer.update("record_count", self._record_count)
+        self.ec_producer.update("metrics_update_count",
+                                self._metrics_update_count)
         self.remove_message_handler(self._log_handler,
                                     self.log_topic_pattern)
+        self.remove_message_handler(self._metrics_handler,
+                                    self.metrics_topic_pattern)
         super().stop()

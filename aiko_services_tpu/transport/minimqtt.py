@@ -23,6 +23,7 @@ import struct
 import threading
 import time as _time
 
+from ..observe.metrics import get_registry
 from ..utils import get_logger
 from .base import topic_matches
 
@@ -415,8 +416,13 @@ class Client:
         data = (payload.encode("utf-8") if isinstance(payload, str)
                 else bytes(payload or b""))
         flags = 0x01 if retain else 0x00
-        return self._send(
-            _packet(PUBLISH, flags, _encode_string(topic) + data))
+        packet = _packet(PUBLISH, flags, _encode_string(topic) + data)
+        result = self._send(packet)
+        if result == 0:
+            metrics = get_registry()
+            metrics.counter("mqtt.publish_count").inc()
+            metrics.counter("mqtt.publish_bytes").inc(len(packet))
+        return result
 
     def subscribe(self, topic) -> int:
         self._packet_id = (self._packet_id % 0xFFFF) + 1
@@ -525,6 +531,10 @@ class Client:
                 if not self._closing:
                     _LOGGER.debug("minimqtt connect failed: %s", error)
             was_connected = self._connected.is_set()
+            if was_connected and not self._closing:
+                # abnormal loss about to retry: the reconnect rate is
+                # the first thing to look at on a flapping deployment
+                get_registry().counter("mqtt.reconnects").inc()
             self._connected.clear()
             with self._ping_cond:
                 # outstanding pings died with the socket: resync the
@@ -567,9 +577,13 @@ class Client:
             packet_type, _flags_unused, body = packet
             if packet_type == CONNACK:
                 self._connected.set()
+                get_registry().counter("mqtt.connects").inc()
                 if self.on_connect is not None:
                     self.on_connect(self, None, None, 0, None)
             elif packet_type == PUBLISH:
+                metrics = get_registry()
+                metrics.counter("mqtt.receive_count").inc()
+                metrics.counter("mqtt.receive_bytes").inc(len(body))
                 reader = _Reader(body)
                 topic = reader.string().decode("utf-8", "replace")
                 if self.on_message is not None:

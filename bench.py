@@ -39,6 +39,17 @@ SMOKE = os.environ.get("AIKO_BENCH_SMOKE", "") not in ("", "0")
 # sources synthesize in HBM by default (measure model compute, not host
 # ingest); AIKO_BENCH_ON_DEVICE=0 reverts to host-synthesized frames
 ON_DEVICE = os.environ.get("AIKO_BENCH_ON_DEVICE", "1") != "0"
+# pipeline telemetry (metrics + frame tracing) rides every benched
+# pipeline unless AIKO_BENCH_TELEMETRY=0 -- the off arm measures the
+# instrumentation overhead (BENCH_NOTES records the A/B); the flag is
+# published in every config block so A/B JSON is self-describing
+TELEMETRY = os.environ.get("AIKO_BENCH_TELEMETRY", "1") != "0"
+# --trace <path>: accumulate Chrome-trace events from every benched
+# pipeline (the config-5 graph included) and ship the Perfetto-loadable
+# file alongside the JSON
+_TRACE_PATH = None
+_TRACE_EVENTS: list = []
+_TRACE_DROPPED = 0
 
 ELEMENTS = "aiko_services_tpu.elements"
 
@@ -157,6 +168,12 @@ def _run_pipeline(definition, warmup: int, measure: int,
     if latency_frames is None:
         latency_frames = 5 if SMOKE else 30
 
+    # pipeline-level parameters: telemetry on/off is the measured A/B
+    # knob; the long metrics_interval keeps the export timer out of
+    # short measurement windows
+    definition.setdefault("parameters", {}).setdefault(
+        "telemetry", TELEMETRY)
+    definition["parameters"].setdefault("metrics_interval", 60.0)
     process = Process(transport_kind="loopback")
     pipeline = create_pipeline(process, definition)
     process.run(in_thread=True)
@@ -201,6 +218,13 @@ def _run_pipeline(definition, warmup: int, measure: int,
     drain_start = time.perf_counter()
     drain = _honest_elapsed(drain_start, lat_refs)  # device backlog
     pipeline.destroy_stream("latency")
+    if _TRACE_PATH:
+        # harvest this pipeline's frame traces before teardown; every
+        # benched graph lands in ONE Perfetto file (distinct process
+        # names per config)
+        global _TRACE_DROPPED
+        _TRACE_EVENTS.extend(pipeline.telemetry.chrome_events())
+        _TRACE_DROPPED += pipeline.telemetry.tracer.dropped
     process.terminate()
     # a stage that drops "t0" would silently degrade p50 into a
     # throughput-derived estimate -- fail loudly instead
@@ -243,6 +267,7 @@ def bench_text():
     fps, p50, drain_pf, _ = _run_pipeline(
         definition, warmup=50, measure=measure, ready_key="text")
     return {"frames_per_sec": round(fps, 1),
+            "telemetry": TELEMETRY,
             **_latency_fields(p50, drain_pf, digits=3),
             "vs_reference_broker_ceiling": round(
                 fps / REFERENCE_FRAMES_PER_SEC, 1)}
@@ -291,6 +316,7 @@ def bench_asr(peak):
     n_frames = int(seconds * 100) // 2  # mel 10 ms hop, conv /2
     flops = asr_flops_per_example(config, n_frames, max_tokens) * batch
     return {"frames_per_sec_chip": round(fps, 2),
+            "telemetry": TELEMETRY,
             "audio_sec_per_sec": round(fps * batch * seconds, 1),
             **_latency_fields(p50, drain_pf),
             "model": preset,
@@ -337,6 +363,7 @@ def bench_detector(peak):
         definition, warmup=warmup, measure=measure, ready_key="detections")
     flops = detector_flops_per_image(config) * batch
     return {"frames_per_sec_chip": round(fps, 2),
+            "telemetry": TELEMETRY,
             "images_per_sec": round(fps * batch, 1),
             **_latency_fields(p50, drain_pf),
             "model": f"{preset} {size}x{size}",
@@ -785,6 +812,7 @@ def bench_multimodal(peak):
     flops = _multimodal_flops(asr_config, lm_config, det_config, batch,
                               max_tokens, max_new, audio_seconds)
     return {"frames_per_sec_chip": round(fps, 2),
+            "telemetry": TELEMETRY,
             **_latency_fields(p50, drain_pf),
             "audio_seconds_per_frame": audio_seconds,
             "rows_per_frame": batch,
@@ -824,6 +852,7 @@ def bench_latency(peak):
     flops = _multimodal_flops(asr_config, lm_config, det_config, batch,
                               max_tokens, max_new, audio_seconds)
     return {"frames_per_sec_chip": round(fps, 2),
+            "telemetry": TELEMETRY,
             **_latency_fields(p50, drain_pf),
             "audio_seconds_per_frame": audio_seconds,
             "rows_per_frame": batch,
@@ -870,6 +899,8 @@ def bench_serving(peak):
     def run(micro):
         definition = {
             "name": "bench_serving",
+            "parameters": {"telemetry": TELEMETRY,
+                           "metrics_interval": 60.0},
             "graph": ["(detector)"],
             "elements": [
                 {"name": "detector", "input": [{"name": "image"}],
@@ -909,6 +940,10 @@ def bench_serving(peak):
             _, _, outputs = responses.get(timeout=900)
             refs.append(outputs.get("detections"))
         elapsed = _honest_elapsed(start, refs)
+        if _TRACE_PATH:
+            global _TRACE_DROPPED
+            _TRACE_EVENTS.extend(pipeline.telemetry.chrome_events())
+            _TRACE_DROPPED += pipeline.telemetry.tracer.dropped
         process.terminate()
         return total / elapsed
 
@@ -937,6 +972,7 @@ def bench_serving(peak):
     flops = detector_flops_per_image(config)
     return {
         "streams": streams_n,
+        "telemetry": TELEMETRY,
         "frames_per_sec_total": round(med_coalesced, 1),
         "coalesced_trials": [round(value, 1) for value in fps_coalesced],
         "coalesced_spread": [round(min(fps_coalesced), 1),
@@ -997,6 +1033,7 @@ def bench_tts(peak):
                / config.sample_rate)
     flops = tts_flops_per_example(config, len(phrase)) * batch
     return {"frames_per_sec_chip": round(fps, 2),
+            "telemetry": TELEMETRY,
             **_latency_fields(p50, drain_pf),
             "audio_seconds_per_frame": round(seconds * batch, 2),
             "speech_sec_per_sec": round(fps * batch * seconds, 1),
@@ -1044,8 +1081,10 @@ def compact_headline(detail: dict, cap: int = HEADLINE_LINE_CAP) -> str:
     compact["detail_file"] = "BENCH_DETAIL.json"
     # progressive field drops keep the guarantee even if units/summary
     # grow; never drop metric/value/vs_baseline
-    for drop in (None, "summary", "baseline", "unit",
-                 "peak_tflops_assumed", "device_fallback"):
+    for drop in (None, "trace_file", "trace_events",
+                 "trace_frames_dropped", "summary",
+                 "baseline", "unit", "peak_tflops_assumed",
+                 "device_fallback"):
         if drop is not None:
             compact.pop(drop, None)
         line = json.dumps(compact)
@@ -1079,7 +1118,14 @@ def _accelerator_failure(timeout: float = 120.0) -> str | None:
 
 
 def main() -> None:
-    global SMOKE
+    global SMOKE, _TRACE_PATH
+    argv = sys.argv[1:]
+    if "--trace" in argv:
+        index = argv.index("--trace")
+        if index + 1 >= len(argv):
+            print("usage: bench.py [--trace <path>]", file=sys.stderr)
+            os._exit(2)
+        _TRACE_PATH = argv[index + 1]
     platform = os.environ.get("AIKO_BENCH_PLATFORM")
     device_fallback = None
     if platform:
@@ -1165,10 +1211,25 @@ def main() -> None:
         "device": jax.devices()[0].device_kind,
         "peak_tflops_assumed": (round(peak / 1e12, 1) if peak else None),
         "smoke": SMOKE,
+        "telemetry": TELEMETRY,
         "configs": configs,
     }
     if device_fallback:
         result["device_fallback"] = device_fallback
+    if _TRACE_PATH:
+        # the trace artifact ships alongside the JSON: every benched
+        # pipeline's frame spans in one Perfetto-loadable file
+        from aiko_services_tpu.observe import chrome_trace_document
+        try:
+            with open(_TRACE_PATH, "w") as handle:
+                json.dump(chrome_trace_document(_TRACE_EVENTS), handle)
+            result["trace_file"] = _TRACE_PATH
+            result["trace_events"] = len(_TRACE_EVENTS)
+            # truncation is explicit: frames evicted from the bounded
+            # per-pipeline trace rings (raise with `trace_ring`)
+            result["trace_frames_dropped"] = _TRACE_DROPPED
+        except OSError as error:
+            result["trace_error"] = str(error)
     # full detail: a file (committed evidence) + an earlier output line;
     # the FINAL line is compact so the driver's ~2000-char tail window
     # always contains it whole (round-4 lesson: BENCH_r04 parsed null).

@@ -1,0 +1,310 @@
+# Observability tests: metrics registry (merge associativity, wire
+# round-trip), frame tracing (Chrome-trace schema, span tree vs the
+# pipeline graph, parked/resumed + fused-group frames), the
+# telemetry-disabled mode (zero per-frame keys), periodic export into
+# the Recorder's metrics plane, and the Recorder's stop() count flush.
+
+import json
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.observe import (
+    MetricsRegistry, merge_snapshots, snapshot_from_wire)
+from aiko_services_tpu.dashboard import format_snapshot_lines
+from aiko_services_tpu.pipeline import (
+    AsyncHostElement, ComputeElement, PipelineElement, StreamEvent,
+    create_pipeline)
+from aiko_services_tpu.runtime import Process, Recorder
+from aiko_services_tpu.transport import get_broker, reset_brokers
+from aiko_services_tpu.utils import parse
+from helpers import wait_for
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+# -- elements under observation (loaded by module path) ----------------------
+
+class FusedScale(ComputeElement):
+    """Pure compute element: inherits the free group_kernel, so the
+    micro-batch scheduler runs it through the FUSED whole-group path."""
+
+    def compute(self, state, x):
+        return {"y": x * 2.0}
+
+
+class SlowAsync(AsyncHostElement):
+    """Parks the frame on a worker thread (StreamEvent.PENDING), then
+    resumes through process_frame_response -- the parked/resumed shape."""
+
+    def process_async(self, stream, y):
+        time.sleep(0.005)
+        return {"z": np.asarray(y) + 1.0}
+
+
+class PlainDouble(PipelineElement):
+    def process_frame(self, stream, x):
+        return StreamEvent.OKAY, {"y": np.asarray(x) * 2.0}
+
+
+def _local(class_name):
+    return {"local": {"module": "tests.test_observe",
+                      "class_name": class_name}}
+
+
+def _observed_definition(telemetry=True, micro_batch=4):
+    return {
+        "name": "observed",
+        "parameters": {"telemetry": telemetry, "metrics_interval": 0},
+        "graph": ["(fused (host))"],
+        "elements": [
+            {"name": "fused", "input": [{"name": "x"}],
+             "output": [{"name": "y"}],
+             "parameters": {"micro_batch": micro_batch},
+             "deploy": _local("FusedScale")},
+            {"name": "host", "input": [{"name": "y"}],
+             "output": [{"name": "z"}],
+             "deploy": _local("SlowAsync")},
+        ],
+    }
+
+
+# -- metrics registry --------------------------------------------------------
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        registry = MetricsRegistry()
+        registry.counter("events").inc()
+        registry.counter("events").inc(4)
+        registry.gauge("depth").set(7)
+        histogram = registry.histogram("lat_s")
+        for value in (0.0001, 0.004, 0.004, 2.5):
+            histogram.record(value)
+        snapshot = registry.snapshot()
+        assert snapshot["counters"]["events"] == 5
+        assert snapshot["gauges"]["depth"] == 7.0
+        record = snapshot["histograms"]["lat_s"]
+        assert record["count"] == 4
+        assert record["min"] == 0.0001 and record["max"] == 2.5
+        assert sum(record["buckets"]) == 4
+        assert abs(record["sum"] - 2.5081) < 1e-9
+
+    def test_histogram_merge_associative(self):
+        registries = [MetricsRegistry() for _ in range(3)]
+        # dyadic values: float addition is exact in ANY grouping, so
+        # associativity is checked structurally, not up-to-rounding
+        samples = ([0.5, 0.03125, 4.0], [2.0 ** -16, 0.25],
+                   [1.0, 1.0, 0.00390625])
+        for registry, values in zip(registries, samples):
+            for value in values:
+                registry.histogram("h").record(value)
+            registry.counter("n").inc(len(values))
+        one, two, three = (r.snapshot() for r in registries)
+        left = merge_snapshots(merge_snapshots(one, two), three)
+        right = merge_snapshots(one, merge_snapshots(two, three))
+        assert left == right
+        assert left["counters"]["n"] == 8
+        assert left["histograms"]["h"]["count"] == 8
+        assert left["histograms"]["h"]["min"] == 2.0 ** -16
+        assert left["histograms"]["h"]["max"] == 4.0
+        # empty-side merge keeps real min/max (placeholder must not win)
+        empty = MetricsRegistry()
+        empty.histogram("h")
+        merged = merge_snapshots(empty.snapshot(), left)
+        assert merged["histograms"]["h"]["min"] == 2.0 ** -16
+
+    def test_sexpr_wire_round_trip(self):
+        registry = MetricsRegistry()
+        registry.counter("pipeline.frames_total").inc(42)
+        registry.gauge("cohorts:detector").set(2.0)
+        registry.histogram("element_s:asr").record(0.0123)
+        payload = registry.to_payload("ns/host/1/2")
+        command, parameters = parse(payload)
+        assert command == "metrics"
+        assert parameters[0] == "ns/host/1/2"
+        restored = snapshot_from_wire(parameters[1])
+        assert restored == registry.snapshot()
+        # snapshot lines render for dashboards without raising
+        assert any("element_s:asr" in line
+                   for line in format_snapshot_lines(restored))
+
+
+# -- frame tracing through the engine ----------------------------------------
+
+class TestTracing:
+    def _run_observed(self, frames=4):
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, _observed_definition())
+        responses = queue.Queue()
+        stream = pipeline.create_stream("s1", queue_response=responses)
+        for index in range(frames):  # queued before the loop: all park
+            pipeline.create_frame(
+                stream, {"x": np.full((2, 3), float(index), np.float32)})
+        process.run(in_thread=True)
+        outputs = [responses.get(timeout=30) for _ in range(frames)]
+        return process, pipeline, outputs
+
+    def test_trace_spans_cover_graph_with_fused_and_parked_frame(
+            self, tmp_path):
+        process, pipeline, outputs = self._run_observed()
+        try:
+            for _, frame, output in outputs:
+                assert np.asarray(output["z"]).shape == (2, 3)
+                # compat keys survive, queue-wait reported apart
+                assert "time_fused" in frame.metrics
+                assert "time_host" in frame.metrics
+                assert "time_queue_fused" in frame.metrics
+                assert frame.metrics["time_pipeline"] > 0
+            traces = list(pipeline.telemetry.tracer.completed)
+            assert len(traces) == 4
+            assert len({trace.trace_id for trace in traces}) == 4
+            for trace in traces:
+                kinds = {(kind, name) for kind, name, *_ in trace.events}
+                names = {name for _, name, *_ in trace.events}
+                # element spans cover every node on the graph path
+                assert {"fused", "host"} <= names
+                assert ("X", f"queue:fused") in kinds
+                assert ("i", "park:host") in kinds
+                assert ("i", "resume:host") in kinds
+                fused_span = next(
+                    event for event in trace.events
+                    if event[0] == "X" and event[1] == "fused")
+                assert fused_span[5]["path"] == "fused"
+                assert fused_span[5]["group"] >= 1
+            # registry: fused dispatches counted, occupancy recorded
+            snapshot = pipeline.telemetry.registry.snapshot()
+            assert snapshot["counters"]["pipeline.frames_total"] == 4
+            assert snapshot["counters"]["pipeline.fused_groups"] >= 1
+            assert snapshot["counters"]["pipeline.compiles_fused"] >= 1
+            assert snapshot["histograms"]["element_s:fused"]["count"] == 4
+            assert snapshot["histograms"]["queue_s:fused"]["count"] == 4
+            # export: schema-valid, Perfetto-loadable JSON
+            path = tmp_path / "trace.json"
+            count = pipeline.telemetry.export_trace(str(path))
+            document = json.loads(path.read_text())
+            assert isinstance(document["traceEvents"], list)
+            assert len(document["traceEvents"]) == count
+            for event in document["traceEvents"]:
+                assert {"ph", "name", "pid", "tid"} <= set(event)
+                if event["ph"] in ("X", "i"):
+                    assert isinstance(event["ts"], (int, float))
+                if event["ph"] == "X":
+                    assert event["dur"] >= 0
+            # span tree: every frame's element/queue spans nest inside
+            # that frame's top-level span bounds
+            frames = [event for event in document["traceEvents"]
+                      if event.get("cat") == "frame"]
+            assert len(frames) == 4
+            for frame_event in frames:
+                trace_id = frame_event["args"]["trace_id"]
+                start = frame_event["ts"]
+                end = start + frame_event["dur"]
+                children = [
+                    event for event in document["traceEvents"]
+                    if event["ph"] == "X" and event.get("cat") != "frame"
+                    and event.get("args", {}).get("trace_id") == trace_id]
+                assert {"fused", "host", "queue:fused"} <= {
+                    event["name"] for event in children}
+                slack = 2000.0  # us: async resume timestamps are approx
+                for child in children:
+                    assert child["ts"] >= start - slack
+                    assert child["ts"] + child["dur"] <= end + slack
+        finally:
+            process.terminate()
+
+    def test_metrics_disabled_writes_zero_per_frame_keys(self):
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(
+            process, _observed_definition(telemetry=False))
+        process.run(in_thread=True)
+        responses = queue.Queue()
+        stream = pipeline.create_stream("s1", queue_response=responses)
+        for index in range(2):
+            pipeline.create_frame(
+                stream, {"x": np.ones((2, 3), np.float32) * index})
+        for _ in range(2):
+            _, frame, output = responses.get(timeout=30)
+            assert np.asarray(output["z"]).shape == (2, 3)
+            assert frame.metrics == {}  # ZERO per-frame keys
+            assert frame.trace is None
+        assert not pipeline.telemetry.enabled
+        assert list(pipeline.telemetry.tracer.completed) == []
+        snapshot = pipeline.telemetry.registry.snapshot()
+        # pre-registered hot-path counters exist but never ticked
+        assert all(value == 0 for value in snapshot["counters"].values())
+        assert snapshot["histograms"] == {}
+        process.terminate()
+
+
+# -- export over the control plane -------------------------------------------
+
+class TestExport:
+    def test_periodic_publish_reaches_recorder(self):
+        process = Process(transport_kind="loopback")
+        recorder = Recorder(process)
+        definition = {
+            "name": "exported",
+            "parameters": {"metrics_interval": 0.05},
+            "graph": ["(double)"],
+            "elements": [
+                {"name": "double", "input": [{"name": "x"}],
+                 "output": [{"name": "y"}],
+                 "deploy": _local("PlainDouble")},
+            ],
+        }
+        pipeline = create_pipeline(process, definition)
+        process.run(in_thread=True)
+        responses = queue.Queue()
+        stream = pipeline.create_stream("s1", queue_response=responses)
+        pipeline.create_frame(stream, {"x": np.ones((2,), np.float32)})
+        responses.get(timeout=10)
+        wait_for(lambda: recorder.metrics_sources(), timeout=10)
+        # two sources ride the one topic: the pipeline's registry and
+        # the process-global one (deduplicated by source name, so N
+        # pipelines cannot inflate the fleet merge)
+        wait_for(lambda: pipeline.topic_path
+                 in recorder.metrics_sources(), timeout=10)
+        source = pipeline.topic_path
+        # the global-registry source is keyed by OS pid (NOT the
+        # Process object's possibly "-N"-suffixed process_id): every
+        # Process object in one interpreter shares one global registry
+        import os
+        process_source = (f"{process.namespace}/{process.hostname}/"
+                          f"{os.getpid()}/process")
+        wait_for(lambda: process_source in recorder.metrics_sources(),
+                 timeout=10)
+        snapshot = wait_for(
+            lambda: (recorder.metrics_for(source) or {}).get(
+                "counters", {}).get("pipeline.frames_total")
+            and recorder.metrics_for(source), timeout=10)
+        assert snapshot["counters"]["pipeline.frames_total"] >= 1
+        assert recorder.merged_metrics()["counters"][
+            "pipeline.frames_total"] >= 1
+        # pipeline EC share mirrors the compact summary for dashboards
+        wait_for(lambda: isinstance(
+            pipeline.share.get("metrics"), dict), timeout=10)
+        assert pipeline.share["metrics"]["frames"] >= 1
+        process.terminate()
+
+    def test_recorder_flushes_record_count_on_stop(self):
+        process = Process(transport_kind="loopback")
+        recorder = Recorder(process)
+        process.run(in_thread=True)
+        log_topic = f"{process.namespace}/host/9/1/log"
+        for index in range(5):
+            process.publish(log_topic, f"line {index}")
+        get_broker().drain()
+        wait_for(lambda: len(recorder.records(log_topic)) == 5)
+        # modulo-16 rate limit: the live share is still stale...
+        assert recorder.share.get("record_count") == 0
+        recorder.stop()
+        # ...stop() flushes the final count
+        assert recorder.share.get("record_count") == 5
+        process.terminate()
