@@ -1,0 +1,272 @@
+# Deterministic fault injection: the measurement substrate for the
+# stream fault-tolerance layer.
+#
+# At the ROADMAP scale (heavy traffic from millions of users) transient
+# faults are the steady state, so the retry/dead-letter/circuit-breaker
+# machinery in pipeline.py and transfer.py needs a way to be PROVEN, not
+# just reasoned about.  This module provides seeded, deterministic
+# injection points the engine and the transfer plane consult:
+#
+#   element_raise    one element call fails (as if process_frame raised)
+#   fetch_drop       a transfer-plane fetch attempt dies with a socket
+#                    error before dialing
+#   reply_blackhole  a process_frame_response for a node is swallowed
+#                    (a dead RemoteElement / lost reply)
+#   dispatch_delay   extra host latency before an element dispatch
+#   connection_drop  an MQTT connection is severed abnormally (consumed
+#                    by tests driving the embedded broker)
+#
+# Determinism contract: rate-based selection hashes (seed, point, node,
+# frame_id) -- the SAME frames are poisoned on every run with the same
+# seed, independent of call order, thread timing, or how many other
+# injection points fired.  Count-based directives (frame=k, times=n)
+# consume deterministically in call order within one injector.
+#
+# Spec grammar (pipeline parameter `faults` or the AIKO_FAULTS env var):
+#
+#   spec      := directive (";" directive)*
+#   directive := "seed=" int
+#              | point (":" key "=" value)*
+#   point     := element_raise | fetch_drop | reply_blackhole
+#              | dispatch_delay | connection_drop
+#   keys      := node=<name> frame=<int> rate=<float 0..1>
+#                times=<int, -1 = unlimited> ms=<float>
+#                once=<1: each selected frame fails at most once>
+#
+# Examples:
+#   "seed=7;element_raise:node=asr:frame=3:times=1"   transient: frame 3
+#                                                     fails once, retries
+#                                                     succeed
+#   "seed=7;element_raise:node=detector:rate=0.01:once=1"
+#                                                     transient 1% faults
+#   "seed=7;element_raise:node=detector:rate=0.01:times=-1"
+#                                                     permanent 1% faults
+#   "fetch_drop:times=1"                              first fetch attempt
+#                                                     dies; retry survives
+#   "reply_blackhole:node=remote_add:times=1;dispatch_delay:ms=5:rate=0.1"
+#
+# Cost contract: a pipeline without a spec holds injector None and every
+# hot-path hook is one `is not None` check; the bench A/B (bench.py
+# --faults) proves the disabled path stays off the hot path.
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+__all__ = ["FaultInjector", "create_injector", "get_injector",
+           "reset_injector"]
+
+_POINTS = ("element_raise", "fetch_drop", "reply_blackhole",
+           "dispatch_delay", "connection_drop")
+
+
+class _Rule:
+    """One parsed directive for one injection point."""
+
+    __slots__ = ("node", "frame", "rate", "times", "ms", "once",
+                 "fired", "seen", "calls")
+
+    def __init__(self, args: dict):
+        self.node = args.get("node")
+        self.frame = (int(args["frame"]) if "frame" in args else None)
+        self.rate = (float(args["rate"]) if "rate" in args else None)
+        self.times = int(args.get("times", 1 if self.rate is None else -1))
+        self.ms = float(args.get("ms", 0.0))
+        # once=1: each selected (node, frame) fires at most ONCE -- the
+        # transient-fault shape (a retry of the same frame succeeds),
+        # vs the default where a selected frame fails on every attempt
+        self.once = str(args.get("once", "")).lower() in ("1", "true")
+        self.fired = 0
+        self.seen: set = set()
+        # consumed-call ordinal for points with NO frame identity
+        # (fetch_drop, connection_drop, reply_blackhole): it stands in
+        # for frame_id, so rate= draws vary per call instead of
+        # degenerating to a constant, and frame=k targets the k-th
+        # call (0-based)
+        self.calls = 0
+
+    def exhausted(self) -> bool:
+        return self.times >= 0 and self.fired >= self.times
+
+
+class FaultInjector:
+    """Parsed fault plan with per-rule consumption state.  One injector
+    per pipeline (from the `faults` pipeline parameter) or per process
+    (from AIKO_FAULTS); stats() reports every injection fired, keyed by
+    point, so harnesses can reconcile injected vs recovered."""
+
+    def __init__(self, spec: str, seed: int = 0,
+                 rules: dict | None = None):
+        self.spec = spec
+        self.seed = seed
+        self._rules: dict[str, list[_Rule]] = rules or {}
+        self._lock = threading.Lock()
+        self._stats: dict[str, int] = {}
+
+    # -- deterministic selection ---------------------------------------
+
+    def _selected(self, rule: _Rule, point: str, node, frame_id,
+                  scope) -> bool:
+        """Does this rule target (node, frame_id)?  Rate-based selection
+        is a pure function of (seed, point, node, scope, frame_id):
+        stable across runs, call order, and interleaving.  `scope` (the
+        stream id in the pipeline hooks) decorrelates equal frame ids on
+        different streams."""
+        if rule.node is not None and node is not None \
+                and rule.node != str(node):
+            return False
+        if rule.frame is not None:
+            return frame_id is not None and int(frame_id) == rule.frame
+        if rule.rate is not None:
+            key = (f"{self.seed}:{point}:{node}:{scope}:"
+                   f"{frame_id}").encode()
+            digest = hashlib.blake2b(key, digest_size=8).digest()
+            draw = int.from_bytes(digest, "big") / float(1 << 64)
+            return draw < rule.rate
+        return True  # bare directive: every call until times exhausted
+
+    def _fire(self, point: str, node=None, frame_id=None,
+              scope="") -> _Rule | None:
+        rules = self._rules.get(point)
+        if not rules:
+            return None
+        with self._lock:
+            for rule in rules:
+                if rule.exhausted():
+                    continue
+                if (rule.node is not None and node is not None
+                        and rule.node != str(node)):
+                    # node filter BEFORE the ordinal: other nodes' calls
+                    # must not consume this rule's draws, or which call
+                    # gets poisoned would depend on interleaving --
+                    # breaking the determinism contract
+                    continue
+                rule_frame_id = frame_id
+                if frame_id is None:
+                    # identity-less call: the per-rule ordinal is the
+                    # frame id (each call is one independent draw)
+                    rule_frame_id = rule.calls
+                    rule.calls += 1
+                if not self._selected(rule, point, node, rule_frame_id,
+                                      scope):
+                    continue
+                if rule.once:
+                    key = (str(node), scope, rule_frame_id)
+                    if key in rule.seen:
+                        continue  # this frame already took its fault
+                    rule.seen.add(key)
+                rule.fired += 1
+                self._stats[point] = self._stats.get(point, 0) + 1
+                return rule
+        return None
+
+    def _peek(self, point: str, node=None, frame_id=None,
+              scope="") -> bool:
+        rules = self._rules.get(point)
+        if not rules:
+            return False
+        with self._lock:
+            return any(
+                not rule.exhausted()
+                and self._selected(
+                    rule, point, node,
+                    rule.calls if frame_id is None else frame_id, scope)
+                and not (rule.once
+                         and (str(node), scope,
+                              rule.calls if frame_id is None
+                              else frame_id) in rule.seen)
+                for rule in rules)
+
+    # -- injection points (engine-facing) ------------------------------
+
+    def element_raise(self, node, frame_id, scope="") -> bool:
+        """Consume: should THIS element call fail?"""
+        return self._fire("element_raise", node, frame_id,
+                          scope) is not None
+
+    def element_raise_pending(self, node, frame_id, scope="") -> bool:
+        """Peek without consuming: is (node, frame_id) poisoned?  The
+        micro-batch scheduler uses this to fail the whole-group attempts
+        (fused, then chained) without burning the poisoned frame's
+        consumable, so the per-frame isolation pass still observes it."""
+        return self._peek("element_raise", node, frame_id, scope)
+
+    def fetch_drop(self) -> bool:
+        return self._fire("fetch_drop") is not None
+
+    def reply_blackhole(self, node) -> bool:
+        return self._fire("reply_blackhole", node) is not None
+
+    def dispatch_delay(self, node, frame_id, scope="") -> float:
+        rule = self._fire("dispatch_delay", node, frame_id, scope)
+        return rule.ms / 1000.0 if rule is not None else 0.0
+
+    def connection_drop(self) -> bool:
+        return self._fire("connection_drop") is not None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+
+def create_injector(spec) -> FaultInjector | None:
+    """Parse a fault spec; None/empty spec means no injection (the
+    production state: every hook collapses to one is-None check)."""
+    if not spec:
+        return None
+    spec = str(spec)
+    seed = 0
+    rules: dict[str, list[_Rule]] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        tokens = part.split(":")
+        head = tokens[0].strip()
+        if "=" in head:  # global option (seed=N)
+            name, _, value = head.partition("=")
+            if name.strip() == "seed":
+                seed = int(value)
+                continue
+            raise ValueError(f"unknown fault option: {head!r}")
+        if head not in _POINTS:
+            raise ValueError(
+                f"unknown fault point {head!r} (valid: {_POINTS})")
+        args = {}
+        for token in tokens[1:]:
+            key, _, value = token.partition("=")
+            args[key.strip()] = value.strip()
+        rules.setdefault(head, []).append(_Rule(args))
+    return FaultInjector(spec, seed=seed, rules=rules)
+
+
+# Process-global injector: points with no pipeline context (transfer
+# plane fetches, transport tests) consult this one, configured by the
+# AIKO_FAULTS env var and cached after first read.
+_GLOBAL: FaultInjector | None = None
+_GLOBAL_READ = False
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_injector() -> FaultInjector | None:
+    global _GLOBAL, _GLOBAL_READ
+    if _GLOBAL_READ:
+        # lock-free fast path: the plan is fixed after first read, and
+        # this sits on the tensor-fetch hot path -- concurrent fetches
+        # must not serialize on a mutex for a constant
+        return _GLOBAL
+    with _GLOBAL_LOCK:
+        if not _GLOBAL_READ:
+            _GLOBAL = create_injector(os.environ.get("AIKO_FAULTS"))
+            _GLOBAL_READ = True
+        return _GLOBAL
+
+
+def reset_injector() -> None:
+    """Forget the cached AIKO_FAULTS plan (tests re-read the env)."""
+    global _GLOBAL, _GLOBAL_READ
+    with _GLOBAL_LOCK:
+        _GLOBAL = None
+        _GLOBAL_READ = False

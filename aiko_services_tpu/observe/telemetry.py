@@ -184,6 +184,68 @@ class PipelineTelemetry:
                      elapsed_s * 1e6, {"path": path}))
             trace.instant(f"resume:{node}", "park", None)
 
+    # -- fault tolerance ---------------------------------------------------
+
+    def record_retry(self, frame, node: str, attempt: int,
+                     delay_s: float) -> None:
+        """One element call failed and was scheduled for retry under the
+        `on_error: retry` policy."""
+        if not self.enabled:
+            return
+        self.registry.counter("pipeline.retries").inc()
+        self.registry.counter(f"retries:{node}").inc()
+        trace = frame.trace
+        if trace is not None:
+            trace.instant(f"retry:{node}", "fault",
+                          {"attempt": attempt,
+                           "delay_ms": round(delay_s * 1000, 3)})
+
+    def record_dead_letter(self, node: str | None, reason: str) -> None:
+        if not self.enabled:
+            return
+        self.registry.counter("pipeline.dead_letters").inc()
+        self.registry.counter(f"dead_letters:{reason}").inc()
+
+    def record_park_expired(self, frame, nodes) -> None:
+        """The doubtful-park watchdog released a frame: kills must show
+        up in telemetry, not only as a log line."""
+        if not self.enabled:
+            return
+        self.registry.counter("pipeline.park_expired").inc()
+        trace = frame.trace
+        if trace is not None:
+            trace.instant("park_expired", "fault",
+                          {"nodes": sorted(str(n) for n in nodes)})
+
+    def record_deadline_expired(self, frame) -> None:
+        if not self.enabled:
+            return
+        self.registry.counter("pipeline.deadline_expired").inc()
+        trace = frame.trace
+        if trace is not None:
+            trace.instant("frame_deadline", "fault",
+                          {"pending": sorted(str(n) for n
+                                             in frame.pending_nodes)})
+
+    def record_breaker_trip(self, stream_id: str) -> None:
+        """A stream blew its error budget and was quarantined."""
+        if not self.enabled:
+            return
+        self.registry.counter("pipeline.breaker_trips").inc()
+        self.tracer.instant_global(f"breaker:{stream_id}", "fault", None)
+
+    def record_fused_failure(self, node: str, disabled: bool) -> None:
+        """A fused group program failed at run time (the group retried
+        on the chained path); `disabled` marks the flap limit tripping,
+        after which the element runs chained permanently."""
+        if not self.enabled:
+            return
+        self.registry.counter("pipeline.fused_failures").inc()
+        if disabled:
+            self.registry.counter("pipeline.fused_disabled").inc()
+            self.tracer.instant_global(f"fused_disabled:{node}", "fault",
+                                       None)
+
     # -- micro-batch scheduler ---------------------------------------------
 
     def record_group(self, node: str, size: int, rows: int,
@@ -250,6 +312,9 @@ class PipelineTelemetry:
                 "pipeline.compiles_fused").value,
             "cohort_splits": self.registry.counter(
                 "pipeline.cohort_splits").value,
+            "retries": self.registry.counter("pipeline.retries").value,
+            "dead_letters": self.registry.counter(
+                "pipeline.dead_letters").value,
         }
 
     def _publish_snapshot(self) -> None:

@@ -5,7 +5,7 @@ from .definition import (                                      # noqa: F401
     PipelineDefinition, ElementDefinition, DefinitionError,
     parse_pipeline_definition, validate_pipeline_definition)
 from .element import (                                         # noqa: F401
-    PipelineElement, AsyncHostElement, FrameGeneratorHandle)
+    ErrorPolicy, PipelineElement, AsyncHostElement, FrameGeneratorHandle)
 from .pipeline import Pipeline, RemoteElement, create_pipeline  # noqa: F401
 from .tensors import (                                         # noqa: F401
     encode_frame_data, decode_frame_data, encode_value, decode_value)

@@ -162,6 +162,19 @@ def validate_pipeline_definition(definition: PipelineDefinition) -> Graph:
     names = [element.name for element in definition.elements]
     _require(len(names) == len(set(names)),
              f"Duplicate element names in {definition.name}")
+    # fault-tolerance grammar: a mistyped on_error would silently fall
+    # back to stop_stream at runtime -- reject it at definition time,
+    # wherever it is declared (pipeline-wide or per element)
+    from .element import ERROR_POLICIES
+    for scope_name, parameters in (
+            [(definition.name, definition.parameters)]
+            + [(element.name, element.parameters)
+               for element in definition.elements]):
+        on_error = (parameters or {}).get("on_error")
+        _require(
+            on_error is None or str(on_error).lower() in ERROR_POLICIES,
+            f"{scope_name}: on_error must be one of {ERROR_POLICIES}, "
+            f"got {on_error!r}")
     graph = Graph.traverse(definition.graph)
     for node_name in graph.node_names():
         _require(definition.element(node_name) is not None,

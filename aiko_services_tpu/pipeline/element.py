@@ -17,12 +17,38 @@ import threading
 import time
 
 from ..runtime import Actor
-from ..utils import get_logger
+from ..utils import get_logger, parse_float, parse_int
 from .stream import Stream, StreamEvent, StreamState
 
-__all__ = ["PipelineElement", "AsyncHostElement", "FrameGeneratorHandle"]
+__all__ = ["ErrorPolicy", "PipelineElement", "AsyncHostElement",
+           "FrameGeneratorHandle"]
 
 _LOGGER = get_logger("element")
+
+# `on_error` values an element / stream / pipeline may declare.  The
+# default preserves the original engine contract: an element error
+# destroys the stream (the pipeline survives).
+ERROR_POLICIES = ("stop_stream", "drop_frame", "retry")
+DEFAULT_MAX_RETRIES = 2
+DEFAULT_RETRY_BACKOFF_MS = 10.0
+
+
+class ErrorPolicy:
+    """Resolved per-element error policy: what the engine does when one
+    element call fails for one frame.  Resolved through the normal
+    parameter precedence (stream > element > pipeline), so operators set
+    a pipeline-wide `on_error` and override per element or per stream."""
+
+    __slots__ = ("on_error", "max_retries", "backoff_s")
+
+    def __init__(self, on_error: str, max_retries: int, backoff_s: float):
+        self.on_error = on_error
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+
+    def retry_delay(self, attempt: int) -> float:
+        """Exponential backoff: base * 2^(attempt-1) for attempt >= 1."""
+        return self.backoff_s * (2.0 ** max(attempt - 1, 0))
 
 
 class FrameGeneratorHandle:
@@ -63,9 +89,8 @@ class FrameGeneratorHandle:
             except Exception as error:
                 _LOGGER.error("%s: frame generator failed: %s",
                               self.element.name, error)
-                pipeline.post_message(
-                    "destroy_stream", [stream.stream_id, "error", True])
-                return
+                stream_event, frame_data = StreamEvent.ERROR, {
+                    "diagnostic": str(error)}
             if stream_event == StreamEvent.OKAY:
                 pipeline.create_frame(stream, frame_data or {})
             elif stream_event == StreamEvent.STOP:
@@ -77,9 +102,22 @@ class FrameGeneratorHandle:
             elif stream_event == StreamEvent.ERROR:
                 _LOGGER.error("%s: frame generator error: %s",
                               self.element.name, frame_data)
-                pipeline.post_message(
-                    "destroy_stream", [stream.stream_id, "error", True])
-                return
+                # the source's own error policy decides whether a bad
+                # tick kills the stream (the historical default) or is
+                # skipped like a dropped frame (transient ingest faults
+                # -- a camera hiccup -- must not destroy a long-lived
+                # serving stream when the operator opts into drop_frame)
+                policy = self.element.resolve_error_policy(stream)
+                if policy.on_error == "stop_stream":
+                    pipeline.post_message(
+                        "destroy_stream", [stream.stream_id, "error", True])
+                    return
+                # drop_frame / retry: skip this tick, keep generating --
+                # with a backoff floor so a PERSISTENTLY failing
+                # rate-less source (unplugged camera) degrades to a slow
+                # error log, not a busy-spinning hot thread
+                if not interval:
+                    time.sleep(max(policy.backoff_s, 0.001))
             # DROP_FRAME: skip this tick
             if interval:
                 next_time += interval
@@ -182,6 +220,27 @@ class PipelineElement(Actor):
             self.ec_producer.update(name, value)
         else:
             self.share[name] = value
+
+    def resolve_error_policy(self, stream: Stream = None) -> ErrorPolicy:
+        """The element's effective error policy for `stream` (resolved
+        only on the error path -- the no-fault hot path never pays the
+        parameter lookups)."""
+        on_error = str(self.get_parameter(
+            "on_error", ERROR_POLICIES[0], stream)
+            or ERROR_POLICIES[0]).lower()
+        if on_error not in ERROR_POLICIES:
+            _LOGGER.warning("%s: unknown on_error %r; using stop_stream",
+                            self.definition.name, on_error)
+            on_error = ERROR_POLICIES[0]
+        max_retries = parse_int(
+            self.get_parameter("max_retries", DEFAULT_MAX_RETRIES,
+                               stream), DEFAULT_MAX_RETRIES)
+        backoff_ms = parse_float(
+            self.get_parameter("retry_backoff_ms",
+                               DEFAULT_RETRY_BACKOFF_MS, stream),
+            DEFAULT_RETRY_BACKOFF_MS)
+        return ErrorPolicy(on_error, max(max_retries, 0),
+                           max(backoff_ms, 0.0) / 1000.0)
 
     def stop(self) -> None:
         for handle in self._generators.values():

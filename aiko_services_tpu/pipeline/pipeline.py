@@ -23,11 +23,15 @@ import hashlib
 import json
 import logging
 import time
+import traceback
+from collections import deque
 
+from ..faults import create_injector, get_injector
 from ..observe import PipelineTelemetry
 from ..runtime import Actor, Lease, ServiceFilter, ServicesCache
 from ..runtime.service import SERVICE_PROTOCOL_PIPELINE
-from ..utils import generate, get_logger, load_module
+from ..utils import (
+    generate, get_logger, load_module, parse_float, parse_int)
 from ..utils.padding import bucket_length, pad_axis_to
 from .definition import (
     PipelineDefinition, parse_pipeline_definition,
@@ -41,6 +45,26 @@ __all__ = ["Pipeline", "RemoteElement", "create_pipeline"]
 
 _LOGGER = get_logger("pipeline")
 DEFAULT_GRACE_TIME = 60.0
+# error-budget defaults: disabled unless `error_budget` is declared
+# (stream or pipeline parameter); the window is seconds
+DEFAULT_ERROR_WINDOW = 10.0
+# a fused group program failing this many CONSECUTIVE times at RUN
+# time pins the element to the chained path permanently (a flapping
+# kernel must not pay fused-failure + chained-retry on every group; a
+# healthy fused group in between resets the count)
+FUSED_FLAP_LIMIT = 3
+# dead-letter diagnostics are truncated: the topic carries evidence,
+# not payloads
+_DEAD_LETTER_DIAGNOSTIC_CAP = 500
+
+
+def _diagnostic_of(outputs) -> str:
+    """An element's ERROR payload is not guaranteed to be a dict --
+    _safe_call only validates the StreamEvent half of the tuple, so
+    (StreamEvent.ERROR, "text") reaches the error handlers intact."""
+    if isinstance(outputs, dict):
+        return str(outputs.get("diagnostic") or outputs)
+    return str(outputs)
 
 
 def _canonical_value(value):
@@ -195,6 +219,17 @@ class Pipeline(Actor):
         # programs (a rebuild discards every XLA executable under it)
         self._fused_programs: dict[str, dict] = {}
         self._fused_rejected: set = set()
+        # fused-path circuit breaker: RUN-time program failures per node;
+        # FUSED_FLAP_LIMIT failures pin the node to the chained path
+        self._fused_failures: dict[str, int] = {}
+        self._fused_disabled: set = set()
+        # deterministic fault injection (aiko_services_tpu.faults): the
+        # pipeline parameter `faults` takes precedence, else the
+        # process-wide AIKO_FAULTS plan; None (the production state)
+        # keeps every hook at one is-None check
+        fault_spec = (definition.parameters or {}).get("faults")
+        self.faults = (create_injector(fault_spec) if fault_spec
+                       else get_injector())
         # elements whose parked frames split into parameter-fingerprint
         # cohorts, logged once each (operators see WHY cross-stream
         # coalescing produced small groups)
@@ -339,7 +374,7 @@ class Pipeline(Actor):
             element = self.elements[node_name]
             if not isinstance(element, RemoteElement):
                 stream_event, diagnostic = self._safe_call(
-                    element.start_stream, stream, stream_id)
+                    node_name, element.start_stream, stream, stream_id)
                 if stream_event == StreamEvent.ERROR:
                     _LOGGER.error("%s: start_stream failed at %s: %s",
                                   self.name, node_name, diagnostic)
@@ -385,7 +420,8 @@ class Pipeline(Actor):
                 element.call("destroy_stream", [stream_id])
             else:
                 element.stop_frame_generation(stream_id)
-                self._safe_call(element.stop_stream, stream, stream_id)
+                self._safe_call(node_name, element.stop_stream, stream,
+                                stream_id)
         # pop LAST: "stream gone from pipeline.streams" must imply the
         # stop_stream hooks (writer close/flush) have already run --
         # callers synchronize on stream removal
@@ -448,6 +484,13 @@ class Pipeline(Actor):
         # stream ingress: mint the frame's trace id (spans accumulate on
         # the frame as it moves through the graph)
         self.telemetry.frame_begin(stream, frame)
+        # frame deadline: bounds the WHOLE graph walk including parked
+        # remote/async branches -- a dead RemoteElement or lost reply
+        # releases the frame (dead-lettered) instead of leaking it until
+        # the stream lease expires
+        deadline = self._frame_deadline(stream)
+        if deadline > 0:
+            self._arm_frame_deadline(stream, frame, deadline)
         self._run_frame(stream, frame, resume_after=None)
 
     def process_frame_response(self, stream_dict, frame_data=None) -> None:
@@ -527,6 +570,15 @@ class Pipeline(Actor):
             _LOGGER.debug("%s: response for non-pending node %r on "
                           "frame %s/%s", self.name, resumed_node,
                           stream_id, frame_id)
+            return
+        if (self.faults is not None
+                and self.faults.reply_blackhole(resumed_node)):
+            # injected lost reply: the frame stays parked, exactly as a
+            # dead remote hop leaves it -- frame_deadline is the
+            # recovery path under test
+            _LOGGER.warning(
+                "%s: injected blackhole swallowed %s response on frame "
+                "%s/%s", self.name, resumed_node, stream_id, frame_id)
             return
         if isinstance(frame_data, str):
             try:
@@ -642,8 +694,8 @@ class Pipeline(Actor):
                 time_start += time.perf_counter() - park_start
                 continue  # parked branch; siblings keep dispatching
             element_start = time.perf_counter()
-            stream_event, outputs = self._safe_call(
-                element.process_frame, stream, **inputs)
+            stream_event, outputs = self._dispatch_element(
+                stream, frame, node_name, element, inputs)
             self.telemetry.record_element(
                 frame, node_name, element_start,
                 time.perf_counter() - element_start, path="inline")
@@ -672,18 +724,272 @@ class Pipeline(Actor):
                 self._finish_frame(stream, frame)
                 self.destroy_stream(stream.stream_id, graceful=True)
                 return
-            else:  # ERROR or unknown
-                _LOGGER.error("%s: %s stream %s error: %s",
-                              self.name, node_name, stream.stream_id,
-                              outputs)
-                self._finish_frame(stream, frame, error=True)
-                self.destroy_stream(stream.stream_id,
-                                    state=StreamState.ERROR)
-                return
+            else:  # ERROR or unknown: the element's error policy decides
+                if self._handle_element_error(stream, frame, node_name,
+                                              element, outputs):
+                    continue  # parked for retry; siblings keep dispatching
+                return  # frame released (dropped or stream destroyed)
         self.telemetry.record_pipeline_pass(frame, time_start)
         if frame.pending_nodes:
             return  # parked branches resume this pass later
         self._finish_frame(stream, frame)
+
+    # -- fault tolerance ---------------------------------------------------
+    # Per-element error policy (`on_error: stop_stream | drop_frame |
+    # retry` with max_retries + exponential retry_backoff_ms), a
+    # per-stream error budget (`error_budget` errors inside
+    # `error_window` seconds quarantines the stream), a per-frame
+    # `frame_deadline` covering parked remote/async branches, and
+    # dead-lettering of every error-released frame on
+    # `{topic_path}/dead_letter` (inputs descriptor + diagnostic +
+    # trace id; the Recorder subscribes).  At ROADMAP scale transient
+    # faults are the steady state: a single element exception must
+    # degrade to one retried/dropped frame, never a destroyed stream,
+    # unless the operator kept the stop_stream default.
+
+    def _dispatch_element(self, stream: Stream, frame: Frame,
+                          node_name: str, element, inputs: dict) -> tuple:
+        """One element call for one frame, with the deterministic fault
+        hooks in front (no fault plan -> one is-None check)."""
+        faults = self.faults
+        if faults is not None:
+            delay = faults.dispatch_delay(node_name, frame.frame_id,
+                                          stream.stream_id)
+            if delay > 0:
+                time.sleep(delay)
+            if faults.element_raise(node_name, frame.frame_id,
+                                    stream.stream_id):
+                return StreamEvent.ERROR, {
+                    "node": node_name,
+                    "diagnostic": f"{node_name}: injected fault "
+                                  f"(element_raise frame "
+                                  f"{frame.frame_id})"}
+        return self._safe_call(node_name, element.process_frame,
+                               stream, **inputs)
+
+    def _handle_element_error(self, stream: Stream, frame: Frame,
+                              node_name: str, element, outputs) -> bool:
+        """Apply the element's error policy to one failed frame.
+        Returns True when the frame is still alive (parked for retry) --
+        the caller's graph pass may keep dispatching siblings -- and
+        False when the frame was released (dropped or stream
+        destroyed)."""
+        diagnostic = _diagnostic_of(outputs)
+        policy = element.resolve_error_policy(stream)
+        if policy.on_error == "retry":
+            retries = frame.retries
+            if retries is None:
+                retries = frame.retries = {}
+            attempt = retries.get(node_name, 0) + 1
+            if attempt <= policy.max_retries:
+                retries[node_name] = attempt
+                delay = policy.retry_delay(attempt)
+                _LOGGER.warning(
+                    "%s: %s failed on frame %s/%s (attempt %d/%d), "
+                    "retrying in %.0f ms: %s", self.name, node_name,
+                    stream.stream_id, frame.frame_id, attempt,
+                    policy.max_retries, delay * 1000, diagnostic)
+                self.telemetry.record_retry(frame, node_name, attempt,
+                                            delay)
+                # park while the backoff runs: descendants defer, the
+                # frame cannot finish, and the retry message re-enters
+                # the graph pass with the node eligible again
+                frame.pending_nodes.add(node_name)
+                if delay > 0:
+                    self.post_message_later(
+                        "_retry_element",
+                        [stream.stream_id, frame.frame_id, node_name],
+                        delay)
+                else:
+                    self.post_message(
+                        "_retry_element",
+                        [stream.stream_id, frame.frame_id, node_name])
+                return True
+        budget_tripped = self._note_stream_error(stream)
+        if policy.on_error in ("retry", "drop_frame"):
+            reason = ("retries_exhausted" if policy.on_error == "retry"
+                      else "drop_frame")
+            _LOGGER.error("%s: %s stream %s frame %s error (%s): %s",
+                          self.name, node_name, stream.stream_id,
+                          frame.frame_id, reason, diagnostic)
+            self._dead_letter(stream, frame, node_name, reason,
+                              diagnostic)
+            self._finish_frame(stream, frame, dropped=True, error=True)
+            if budget_tripped:
+                self._quarantine_stream(stream)
+            return False
+        # stop_stream: the original engine contract -- the stream dies,
+        # the pipeline survives
+        _LOGGER.error("%s: %s stream %s error: %s", self.name,
+                      node_name, stream.stream_id, diagnostic)
+        self._dead_letter(stream, frame, node_name, "stop_stream",
+                          diagnostic)
+        self._finish_frame(stream, frame, error=True)
+        self.destroy_stream(stream.stream_id, state=StreamState.ERROR)
+        return False
+
+    def _retry_element(self, stream_id, frame_id, node_name) -> None:
+        """Mailbox/timer continuation of a scheduled retry: un-park the
+        node and re-enter the frame's graph pass (the node re-dispatches
+        inline or re-parks for micro-batching, exactly like a first
+        attempt)."""
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            return  # stream destroyed while the backoff ran
+        frame = stream.frames.get(int(frame_id))
+        if frame is None:
+            return  # frame released meanwhile (deadline/watchdog)
+        node_name = str(node_name)
+        frame.pending_nodes.discard(node_name)
+        self._run_frame(stream, frame, resume_after=None)
+
+    def _stream_parameter(self, stream: Stream, name: str, default):
+        """Stream-level parameter with pipeline-definition fallback (for
+        knobs that are per-stream, not per-element)."""
+        if stream.parameters and name in stream.parameters:
+            return stream.parameters[name]
+        return (self.definition.parameters or {}).get(name, default)
+
+    def _note_stream_error(self, stream: Stream) -> bool:
+        """Record one error against the stream's sliding error budget;
+        True when the budget tripped (caller quarantines).  Budget off
+        (the default) costs one parameter lookup on the ERROR path
+        only."""
+        budget = parse_int(
+            self._stream_parameter(stream, "error_budget", 0), 0)
+        if budget <= 0:
+            return False
+        window = parse_float(
+            self._stream_parameter(stream, "error_window",
+                                   DEFAULT_ERROR_WINDOW),
+            DEFAULT_ERROR_WINDOW) or DEFAULT_ERROR_WINDOW
+        times = stream.error_times
+        if times is None:
+            times = stream.error_times = deque()
+        now = time.monotonic()
+        times.append(now)
+        while times and times[0] < now - window:
+            times.popleft()
+        return len(times) >= budget
+
+    def _quarantine_stream(self, stream: Stream) -> None:
+        _LOGGER.error(
+            "%s: stream %s blew its error budget; quarantining",
+            self.name, stream.stream_id)
+        self.telemetry.record_breaker_trip(stream.stream_id)
+        self.destroy_stream(stream.stream_id, state=StreamState.ERROR)
+
+    def _frame_deadline(self, stream: Stream) -> float:
+        """The stream's `frame_deadline` seconds (0 = disabled),
+        memoized: stream parameters are fixed at create_stream."""
+        cached = getattr(stream, "_frame_deadline_s", None)
+        if cached is None:
+            cached = parse_float(self._stream_parameter(
+                stream, "frame_deadline", 0.0), 0.0)
+            stream._frame_deadline_s = cached
+        return cached
+
+    def _arm_frame_deadline(self, stream: Stream, frame: Frame,
+                            deadline_s: float) -> None:
+        """Bound the frame's END-TO-END residence time.  Generalizes the
+        doubtful-park watchdog: that one only covers parks whose
+        attribution came into doubt, while this covers every way a frame
+        can stall -- a dead RemoteElement, a lost async reply, a
+        wedged element -- and releases the frame (dead-lettered) so its
+        backpressure slot returns well before the stream lease expires."""
+        stream_id, frame_id = stream.stream_id, frame.frame_id
+
+        def expired(_uuid):
+            frame.deadline_lease = None
+            live_stream = self.streams.get(stream_id)
+            if live_stream is None:
+                return
+            if live_stream.frames.get(frame_id) is not frame:
+                return  # finished in time
+            _LOGGER.warning(
+                "%s: frame %s/%s exceeded frame_deadline %.2fs "
+                "(pending: %s); releasing as error", self.name,
+                stream_id, frame_id, deadline_s,
+                sorted(frame.pending_nodes) or "none")
+            self.telemetry.record_deadline_expired(frame)
+            self._dead_letter(
+                live_stream, frame, None, "frame_deadline",
+                f"frame exceeded {deadline_s}s with "
+                f"{sorted(frame.pending_nodes)} in flight")
+            self._finish_frame(live_stream, frame, dropped=True,
+                               error=True)
+
+        frame.deadline_lease = Lease(
+            self.process.event, deadline_s,
+            f"deadline:{stream_id}:{frame_id}",
+            lease_expired_handler=expired)
+
+    @staticmethod
+    def _describe_value(value) -> str:
+        """Compact dead-letter descriptor entry: shape/dtype for arrays,
+        length for strings -- evidence of WHAT was in flight, never the
+        payload itself."""
+        if hasattr(value, "shape") and hasattr(value, "dtype"):
+            return f"{value.dtype}{list(value.shape)}"
+        if isinstance(value, (str, bytes)):
+            return f"{type(value).__name__}[{len(value)}]"
+        if isinstance(value, (list, tuple)):
+            return f"{type(value).__name__}[{len(value)}]"
+        return type(value).__name__
+
+    def _dead_letter(self, stream: Stream, frame: Frame,
+                     node_name, reason: str, diagnostic) -> None:
+        """Publish the failed frame's evidence on
+        `{topic_path}/dead_letter`: inputs DESCRIPTOR (swag keys with
+        shapes/dtypes), diagnostic, and the frame's trace id so the
+        failure joins its trace in the Perfetto export.  Consumed by the
+        Recorder; export failures never mask the engine's own
+        recovery."""
+        self.telemetry.record_dead_letter(node_name, reason)
+        trace = frame.trace
+        meta = {
+            "stream_id": stream.stream_id,
+            "frame_id": frame.frame_id,
+            "node": str(node_name) if node_name else "",
+            "reason": reason,
+            "trace_id": trace.trace_id if trace is not None else "",
+            "diagnostic":
+                str(diagnostic)[:_DEAD_LETTER_DIAGNOSTIC_CAP],
+        }
+        descriptor = {str(key): self._describe_value(value)
+                      for key, value in frame.swag.items()}
+        try:
+            self.process.publish(
+                f"{self.topic_path}/dead_letter",
+                generate("dead_letter", [meta, descriptor]))
+        except Exception as error:
+            _LOGGER.warning("%s: dead-letter publish failed: %s",
+                            self.name, error)
+
+    def _note_fused_failure(self, node_name: str, outputs) -> None:
+        """A fused group program failed at RUN time (resolve-time
+        failures already fall back in _resolve_group_kernel).  Count it;
+        at FUSED_FLAP_LIMIT CONSECUTIVE failures (a later healthy fused
+        group resets the count) the node's fused path is pinned off --
+        a flapping kernel must not pay fused-failure + chained-retry on
+        every group."""
+        count = self._fused_failures.get(node_name, 0) + 1
+        self._fused_failures[node_name] = count
+        disabled = (count >= FUSED_FLAP_LIMIT
+                    and node_name not in self._fused_disabled)
+        if disabled:
+            self._fused_disabled.add(node_name)
+            self._fused_programs.pop(node_name, None)
+            _LOGGER.warning(
+                "%s: %s fused group path failed %d times; pinned to "
+                "the chained path: %s", self.name, node_name, count,
+                _diagnostic_of(outputs))
+        else:
+            _LOGGER.warning(
+                "%s: %s fused group failed (%d/%d); retrying the group "
+                "on the chained path: %s", self.name, node_name, count,
+                FUSED_FLAP_LIMIT, _diagnostic_of(outputs))
+        self.telemetry.record_fused_failure(node_name, disabled)
 
     # -- micro-batching (no reference counterpart: the reference processes
     # one frame per mailbox message, pipeline.py:1037-1092; on TPU the MFU
@@ -949,19 +1255,54 @@ class Pipeline(Actor):
                                     fused=kernel_spec is not None)
         per_frame = None
         element_start = time.perf_counter()
-        if kernel_spec is not None:
+        # injected per-frame faults: a SINGLETON group consumes its
+        # fault here (it goes straight to the error policy -- no
+        # isolation pass would ever consume it); a multi-frame group
+        # only PEEKS, so the consumable fires at the per-frame
+        # isolation call and healthy cohort members complete
+        if self.faults is None:
+            poisoned = False
+        elif len(group) == 1:
+            poisoned = self.faults.element_raise(
+                node_name, group[0][1].frame_id, group[0][0].stream_id)
+        else:
+            poisoned = any(
+                self.faults.element_raise_pending(
+                    node_name, parked.frame_id, parked_stream.stream_id)
+                for parked_stream, parked, _, _ in group)
+        if poisoned:
+            stream_event, outputs = StreamEvent.ERROR, {
+                "diagnostic": f"{node_name}: injected fault in "
+                              f"coalesced group"}
+            # NOT a fused flap: the kernel never executed (the injected
+            # fault models a poisoned ELEMENT input, not a kernel bug),
+            # so the breaker must not pin a healthy kernel chained
+            kernel_spec = None
+        elif kernel_spec is not None:
             stream_event, outputs, per_frame = self._call_fused_group(
                 element, group, kernel_spec, target, split_rows, fillers)
+            if stream_event == StreamEvent.ERROR:
+                # a failed fused group is NOT lost: count the flap
+                # (FUSED_FLAP_LIMIT pins the node chained) and retry the
+                # whole group through the chained path before any
+                # per-frame isolation
+                self._note_fused_failure(node_name, outputs)
+                per_frame = None
+                kernel_spec = None
+                stream_event, outputs = self._call_chained_group(
+                    element, group, lead_stream, target, total, fillers)
+            elif node_name in self._fused_failures:
+                # a healthy fused group closes the flap window: only
+                # CONSECUTIVE failures trip the breaker, so scattered
+                # poison frames over a long deployment never pin a
+                # healthy kernel to the chained path
+                self._fused_failures.pop(node_name, None)
         else:
-            if len(group) == 1 and target == total:
-                coalesced = dict(group[0][2])
-            else:
-                named_arrays = self._gather_named_arrays(group, fillers)
-                coalesced = _concat_pad_program(named_arrays, target)
-            stream_event, outputs = self._safe_call(
-                element.process_frame, lead_stream, **coalesced)
+            stream_event, outputs = self._call_chained_group(
+                element, group, lead_stream, target, total, fillers)
         elapsed = time.perf_counter() - element_start
         share = elapsed / len(group)
+        contract_violation = False
         if stream_event == StreamEvent.PENDING:
             if len(group) == 1:
                 # element continues off the event loop and resumes the
@@ -971,6 +1312,7 @@ class Pipeline(Actor):
                 if group[0][1].paused_pe_name is None:
                     group[0][1].paused_pe_name = node_name
                 return
+            contract_violation = True
             stream_event, outputs = StreamEvent.ERROR, {
                 "diagnostic": (
                     f"{node_name}: StreamEvent.PENDING is incompatible "
@@ -1019,15 +1361,95 @@ class Pipeline(Actor):
                 for stream_id in dict.fromkeys(
                         stream.stream_id for stream, _, _, _ in group):
                     self.destroy_stream(stream_id, graceful=True)
-            else:
+            elif contract_violation or (
+                    len(group) > 1
+                    and element.resolve_error_policy(
+                        lead_stream).on_error == "stop_stream"):
+                # the legacy hard-stop: a misdeclared element (PENDING
+                # from a coalesced call) OR a group under the default
+                # stop_stream policy -- the parameter fingerprint makes
+                # the policy uniform across the group, and re-executing
+                # members in isolation would both duplicate side
+                # effects and break the historical contract the default
+                # preserves
                 _LOGGER.error("%s: %s error: %s", self.name, node_name,
-                              outputs)
+                              _diagnostic_of(outputs))
                 for stream, frame, _, _ in group:
+                    self._dead_letter(stream, frame, node_name,
+                                      "stop_stream",
+                                      _diagnostic_of(outputs))
                     self._finish_frame(stream, frame, error=True)
                 for stream_id in dict.fromkeys(
                         stream.stream_id for stream, _, _, _ in group):
                     self.destroy_stream(stream_id,
                                         state=StreamState.ERROR)
+            elif len(group) == 1:
+                stream, frame, _, _ = group[0]
+                if (self.streams.get(stream.stream_id) is stream
+                        and stream.frames.get(frame.frame_id) is frame):
+                    self._handle_element_error(stream, frame, node_name,
+                                               element, outputs)
+            else:
+                # both whole-group attempts failed under an opted-in
+                # recovery policy (drop_frame/retry): one poison frame
+                # must not kill its cohort -- split to per-frame
+                # isolation, where each member takes its own
+                # error-policy path.  Opting in accepts at-least-once
+                # element execution for the group's members
+                self._isolate_micro_group(element, group, node_name,
+                                          outputs)
+
+    def _call_chained_group(self, element, group: list,
+                            lead_stream: Stream, target: int, total: int,
+                            fillers: int) -> tuple:
+        """The chained micro-batch call: jitted concat+pad, then ONE
+        process_frame over the coalesced batch (also the retry path for
+        a failed fused group)."""
+        node_name = element.definition.name
+        if len(group) == 1 and target == total:
+            coalesced = dict(group[0][2])
+        else:
+            named_arrays = self._gather_named_arrays(group, fillers)
+            coalesced = _concat_pad_program(named_arrays, target)
+        return self._safe_call(node_name, element.process_frame,
+                               lead_stream, **coalesced)
+
+    def _isolate_micro_group(self, element, group: list, node_name: str,
+                             group_outputs) -> None:
+        """Per-frame isolation after a whole-group failure: run each
+        member individually with ITS OWN inputs so healthy frames
+        complete and only the poison frame takes the element's error
+        policy (retry re-parks it through the scheduler; drop_frame
+        dead-letters it; stop_stream kills only its own stream)."""
+        _LOGGER.warning(
+            "%s: %s coalesced group of %d failed (%s); splitting to "
+            "per-frame isolation", self.name, node_name, len(group),
+            _diagnostic_of(group_outputs))
+        for stream, frame, inputs, _ in group:
+            if (self.streams.get(stream.stream_id) is not stream
+                    or stream.frames.get(frame.frame_id) is not frame):
+                continue  # finished/destroyed meanwhile
+            stream.current_frame_id = frame.frame_id
+            stream_event, outputs = self._dispatch_element(
+                stream, frame, node_name, element, inputs)
+            if stream_event == StreamEvent.OKAY:
+                frame.swag.update(self._map_out(outputs or {},
+                                                element.definition))
+                self._run_frame(stream, frame, resume_after=node_name)
+            elif stream_event == StreamEvent.PENDING:
+                # the isolated call parked this frame alone -- the
+                # single-frame PENDING contract applies
+                frame.pending_nodes.add(node_name)
+                if frame.paused_pe_name is None:
+                    frame.paused_pe_name = node_name
+            elif stream_event == StreamEvent.DROP_FRAME:
+                self._finish_frame(stream, frame, dropped=True)
+            elif stream_event == StreamEvent.STOP:
+                self._finish_frame(stream, frame)
+                self.destroy_stream(stream.stream_id, graceful=True)
+            else:
+                self._handle_element_error(stream, frame, node_name,
+                                           element, outputs)
 
     def _gather_named_arrays(self, group: list, fillers: int) -> dict:
         """{input name: tuple of per-frame arrays}, entry list padded
@@ -1059,6 +1481,8 @@ class Pipeline(Actor):
         if (type(element).group_kernel
                 is PipelineElement.group_kernel):
             return None  # hook not implemented: chained path
+        if element.definition.name in self._fused_disabled:
+            return None  # circuit breaker: flapping kernel pinned chained
         from ..utils import truthy
         if not truthy(element.get_parameter(
                 "micro_batch_fused", True, stream)):
@@ -1104,9 +1528,9 @@ class Pipeline(Actor):
                 counts=tuple(int(count) for count in split_rows),
                 shared=shared)
         except Exception as error:
-            import traceback
             return StreamEvent.ERROR, {
-                "diagnostic": f"fused group kernel failed: {error}",
+                "diagnostic": f"{element.definition.name}: fused group "
+                              f"kernel failed: {error}",
                 "traceback": traceback.format_exc()}, None
         return StreamEvent.OKAY, {}, list(per_frame[:len(group)])
 
@@ -1270,6 +1694,13 @@ class Pipeline(Actor):
                 "%s: frame %s/%s parks %s still unresolved %.1fs after an "
                 "unroutable response; releasing as error", self.name,
                 stream_id, frame_id, sorted(still_doubtful), timeout)
+            # watchdog kills must show up in telemetry and the dashboard
+            # metrics page, not only in this log line
+            self.telemetry.record_park_expired(frame, still_doubtful)
+            self._dead_letter(
+                live_stream, frame, None, "park_expired",
+                f"parks {sorted(still_doubtful)} unresolved "
+                f"{timeout}s after an unroutable response")
             self._finish_frame(live_stream, frame, dropped=True,
                                error=True)
 
@@ -1277,7 +1708,12 @@ class Pipeline(Actor):
             self.process.event, timeout,
             f"park:{stream_id}:{frame_id}", lease_expired_handler=expired)
 
-    def _safe_call(self, method, *args, **kwargs) -> tuple:
+    def _safe_call(self, node, method, *args, **kwargs) -> tuple:
+        """Run one element hook, mapping exceptions and malformed
+        returns to StreamEvent.ERROR.  `node` is the graph-node name:
+        the diagnostic carries WHICH element blew up, so dead letters
+        and logs are attributable without reconstructing the call site
+        from a traceback."""
         try:
             result = method(*args, **kwargs)
             if result is None:
@@ -1286,12 +1722,15 @@ class Pipeline(Actor):
                     and isinstance(result[0], StreamEvent)):
                 return result
             return StreamEvent.ERROR, {
-                "diagnostic": f"{method.__qualname__} must return "
-                              f"(StreamEvent, dict), got {type(result)}"}
+                "node": str(node),
+                "diagnostic": f"{node}: {method.__qualname__} must "
+                              f"return (StreamEvent, dict), got "
+                              f"{type(result)}"}
         except Exception as error:
-            import traceback
             return StreamEvent.ERROR, {
-                "diagnostic": f"{error}", "traceback": traceback.format_exc()}
+                "node": str(node),
+                "diagnostic": f"{node}: {error}",
+                "traceback": traceback.format_exc()}
 
     def _finish_frame(self, stream: Stream, frame: Frame,
                       dropped: bool = False, error: bool = False) -> None:
@@ -1300,6 +1739,9 @@ class Pipeline(Actor):
         if frame.park_watchdog is not None:
             frame.park_watchdog.terminate()
             frame.park_watchdog = None
+        if frame.deadline_lease is not None:
+            frame.deadline_lease.terminate()
+            frame.deadline_lease = None
         # in-flight branch work for this frame must never resume it:
         # strip it from every micro-batch pending list
         if frame.pending_nodes:

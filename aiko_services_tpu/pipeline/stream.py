@@ -70,6 +70,14 @@ class Frame:
     # pipeline telemetry is enabled; None otherwise (every tracing hook
     # is then a single is-None check)
     trace: object = None
+    # per-node retry attempts under the `on_error: retry` policy (lazily
+    # built on first retry -- the no-fault hot path never allocates it)
+    retries: dict | None = None
+    # armed (a Lease) when the stream resolves a `frame_deadline`: the
+    # frame is released as an error when the deadline passes with work
+    # still in flight (a dead remote hop / lost reply must not leak the
+    # frame's backpressure slot until the stream lease expires)
+    deadline_lease: object = None
 
 
 @dataclass
@@ -90,6 +98,11 @@ class Stream:
     # explicit context (the reference used thread-locals, pipeline.py:
     # 584-610); AsyncHostElement uses it to address its resume message
     current_frame_id: int | None = None
+    # error-budget window (lazily a deque of monotonic timestamps): when
+    # `error_budget` errors land within `error_window` seconds the
+    # stream is quarantined (destroyed with StreamState.ERROR) instead
+    # of flapping forever under drop_frame/retry policies
+    error_times: object = None
 
     def to_dict(self) -> dict:
         return {"stream_id": self.stream_id, "frame_id": self.frame_id}

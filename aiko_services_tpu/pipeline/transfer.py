@@ -37,6 +37,7 @@ import uuid
 
 import numpy as np
 
+from ..faults import get_injector
 from ..observe.metrics import get_registry
 
 __all__ = [
@@ -76,6 +77,20 @@ def transfer_timeout() -> float:
     loop, so this bounds how long one lost producer can stall the
     process; keep it well under stream grace leases."""
     return float(os.environ.get("AIKO_TRANSFER_TIMEOUT", "10"))
+
+
+def transfer_retries() -> int:
+    """Network-fault fetch attempts beyond the first.  A producer
+    restart, a dropped TCP handshake, or a transient route flap is the
+    steady state at fleet scale; one or two quick retries recover the
+    frame where the old fail-fast contract dropped it.  Expired keys
+    (KeyError) are never retried -- a consumed key will not come back."""
+    return int(os.environ.get("AIKO_TRANSFER_RETRIES", "2"))
+
+
+def transfer_retry_backoff() -> float:
+    """Base retry backoff seconds (doubles per attempt)."""
+    return float(os.environ.get("AIKO_TRANSFER_RETRY_MS", "50")) / 1000.0
 
 
 def transfer_linger() -> float:
@@ -136,18 +151,23 @@ class TensorTransferServer:
         self.ttl = float(ttl)
         self._store: dict[str, tuple[float, np.ndarray]] = {}
         self._lock = threading.Lock()
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        bind_host = os.environ.get("AIKO_TRANSFER_BIND", "0.0.0.0")
-        self._listener.bind((bind_host, int(port)))
-        self._listener.listen(16)
-        self._listener.settimeout(_PURGE_INTERVAL)
+        self._listener = self._make_listener(int(port))
         self.port = self._listener.getsockname()[1]
         self.host = host or _advertised_host()
         self._closed = False
         self._thread = threading.Thread(
             target=self._accept_loop, name="tensor_transfer", daemon=True)
         self._thread.start()
+
+    @staticmethod
+    def _make_listener(port: int) -> socket.socket:
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        bind_host = os.environ.get("AIKO_TRANSFER_BIND", "0.0.0.0")
+        listener.bind((bind_host, port))
+        listener.listen(16)
+        listener.settimeout(_PURGE_INTERVAL)
+        return listener
 
     # -- producer side -------------------------------------------------
 
@@ -182,9 +202,47 @@ class TensorTransferServer:
                 self._purge()  # unfetched arrays die on schedule
                 continue
             except OSError:
-                return  # listener closed
+                if self._closed:
+                    return  # deliberate close()
+                # UNEXPECTED listener death (fd exhaustion, an injected
+                # kill, a stack reset): the advertised (host, port) is
+                # baked into every outstanding descriptor, so restart
+                # the accept loop on the SAME port instead of silently
+                # turning every future fetch into a dropped frame
+                if not self._restart_listener():
+                    return
+                continue
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
+
+    def _restart_listener(self) -> bool:
+        get_registry().counter("transfer.listener_restarts").inc()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for attempt in range(5):
+            if self._closed:
+                return False
+            try:
+                listener = self._make_listener(self.port)
+            except OSError:
+                time.sleep(0.1 * (2.0 ** attempt))  # port still in TIME_WAIT
+                continue
+            with self._lock:
+                if self._closed:
+                    # close() raced the rebind: a fresh listener behind
+                    # a closed server would leak the socket (and hold a
+                    # pinned port against the replacement singleton)
+                    listener.close()
+                    return False
+                self._listener = listener
+            return True
+        # give up with REAL close() semantics: _closed must flip so
+        # get_transfer_server() replaces this instance instead of
+        # handing out descriptors nobody will ever serve
+        self.close()
+        return False
 
     def _handle(self, conn: socket.socket):
         try:
@@ -226,42 +284,66 @@ class TensorTransferServer:
 
     def close(self):
         self._closed = True
-        try:
-            self._listener.close()
-        except OSError:
-            pass
         with self._lock:
+            # under the lock: _restart_listener swaps self._listener
+            # under the same lock, so the close always hits the LIVE
+            # listener, never a just-replaced stale reference
+            try:
+                self._listener.close()
+            except OSError:
+                pass
             self._store.clear()
 
 
-def fetch(descriptor: dict, timeout: float | None = None) -> np.ndarray:
-    """Dial the descriptor's producer and pull the raw buffer.
+def fetch(descriptor: dict, timeout: float | None = None,
+          retries: int | None = None) -> np.ndarray:
+    """Dial the descriptor's producer and pull the raw buffer,
+    retrying network faults with exponential backoff (the linger window
+    keeps the key fetchable across the retry span).
 
     Returns a WRITABLE array (received into a fresh bytearray).  Raises
-    KeyError for consumed/expired keys, TransferError for network faults.
-    """
+    KeyError for consumed/expired keys (never retried), TransferError
+    after `retries` + 1 failed network attempts.  Counters:
+    `transfer.fetch_errors` counts every FAILED ATTEMPT,
+    `transfer.fetch_retries` every retry taken -- on a run where every
+    retry recovered, the two reconcile (errors == retries)."""
     if timeout is None:
         timeout = transfer_timeout()
+    if retries is None:
+        retries = transfer_retries()
     address = (descriptor["host"], int(descriptor["port"]))
     metrics = get_registry()
     fetch_start = time.perf_counter()
-    try:
-        with socket.create_connection(address, timeout=timeout) as conn:
-            conn.settimeout(timeout)
-            conn.sendall(descriptor["key"].encode("ascii") + b"\n")
-            header = _recv_exact(conn, _HEADER.size)
-            (length,) = _HEADER.unpack(header)
-            if length == 0:
-                metrics.counter("transfer.fetch_expired").inc()
-                raise KeyError(
-                    f"tensor {descriptor['key']} expired at "
-                    f"{address[0]}:{address[1]}")
-            raw = _recv_exact(conn, length)
-    except OSError as error:
-        metrics.counter("transfer.fetch_errors").inc()
-        raise TransferError(
-            f"tensor fetch from {address[0]}:{address[1]} failed: "
-            f"{error}") from error
+    backoff = transfer_retry_backoff()
+    injector = get_injector()
+    attempt = 0
+    while True:
+        try:
+            if injector is not None and injector.fetch_drop():
+                raise OSError("injected socket drop (fetch_drop)")
+            with socket.create_connection(address,
+                                          timeout=timeout) as conn:
+                conn.settimeout(timeout)
+                conn.sendall(descriptor["key"].encode("ascii") + b"\n")
+                header = _recv_exact(conn, _HEADER.size)
+                (length,) = _HEADER.unpack(header)
+                if length == 0:
+                    metrics.counter("transfer.fetch_expired").inc()
+                    raise KeyError(
+                        f"tensor {descriptor['key']} expired at "
+                        f"{address[0]}:{address[1]}")
+                raw = _recv_exact(conn, length)
+            break
+        except OSError as error:
+            metrics.counter("transfer.fetch_errors").inc()
+            if attempt >= retries:
+                raise TransferError(
+                    f"tensor fetch from {address[0]}:{address[1]} "
+                    f"failed after {attempt + 1} attempts: "
+                    f"{error}") from error
+            metrics.counter("transfer.fetch_retries").inc()
+            time.sleep(backoff * (2.0 ** attempt))
+            attempt += 1
     metrics.counter("transfer.fetches").inc()
     metrics.counter("transfer.fetched_bytes").inc(length)
     metrics.histogram("transfer.fetch_s").record(

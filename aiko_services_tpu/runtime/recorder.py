@@ -13,13 +13,19 @@
 # keeps the LATEST snapshot per source and merges them associatively into
 # one fleet view (observe.merge_snapshots), so a dashboard or operator
 # asks ONE service for cluster-wide counters/histograms.
+#
+# Fault-tolerance plane: pipelines dead-letter error-released frames on
+# "{topic_path}/dead_letter" (inputs descriptor + diagnostic + trace id,
+# pipeline.py _dead_letter); the Recorder keeps a bounded ring of parsed
+# dead letters so operators inspect WHAT failed, WHERE, and under WHICH
+# trace without grepping logs.
 
 from __future__ import annotations
 
 from collections import deque
 
 from ..observe.metrics import merge_snapshots, parse_metrics_payload
-from ..utils import LRUCache, get_logger
+from ..utils import LRUCache, get_logger, parse
 from .actor import Actor
 from .share import ECProducer
 
@@ -36,6 +42,7 @@ class Recorder(Actor):
     def __init__(self, process, name: str = "recorder",
                  log_topic_pattern: str | None = None,
                  metrics_topic_pattern: str | None = None,
+                 dead_letter_topic_pattern: str | None = None,
                  ring_size: int = RING_SIZE):
         super().__init__(process, name,
                          protocol=SERVICE_PROTOCOL_RECORDER)
@@ -43,17 +50,25 @@ class Recorder(Actor):
             log_topic_pattern or f"{process.namespace}/+/+/+/log")
         self.metrics_topic_pattern = (
             metrics_topic_pattern or f"{process.namespace}/+/+/+/metrics")
+        self.dead_letter_topic_pattern = (
+            dead_letter_topic_pattern
+            or f"{process.namespace}/+/+/+/dead_letter")
         self.ring_size = ring_size
         self.topic_rings = LRUCache(TOPIC_CACHE_SIZE)
         self.metrics_snapshots = LRUCache(METRICS_CACHE_SIZE)
+        self.dead_letter_ring = deque(maxlen=ring_size)
         self.share.update({"topic_count": 0, "record_count": 0,
                            "metrics_source_count": 0,
-                           "metrics_update_count": 0})
+                           "metrics_update_count": 0,
+                           "dead_letter_count": 0})
         self._record_count = 0
         self._metrics_update_count = 0
+        self._dead_letter_count = 0
         self.add_message_handler(self._log_handler, self.log_topic_pattern)
         self.add_message_handler(self._metrics_handler,
                                  self.metrics_topic_pattern)
+        self.add_message_handler(self._dead_letter_handler,
+                                 self.dead_letter_topic_pattern)
 
     def _log_handler(self, topic: str, payload: str) -> None:
         ring = self.topic_rings.get(topic)
@@ -82,6 +97,28 @@ class Recorder(Actor):
             self.ec_producer.update("metrics_update_count",
                                     self._metrics_update_count)
 
+    def _dead_letter_handler(self, topic: str, payload: str) -> None:
+        """One failed frame's evidence: (dead_letter meta descriptor)
+        from a pipeline's fault-tolerance layer.  Stored parsed (topic,
+        meta, inputs-descriptor) so dead_letters() is directly
+        inspectable; every dead letter counts even when the ring
+        evicts."""
+        try:
+            command, parameters = parse(
+                payload if isinstance(payload, str) else str(payload))
+        except ValueError:
+            _LOGGER.debug("undecodable dead letter on %s", topic)
+            return
+        if command != "dead_letter" or not parameters:
+            return
+        meta = parameters[0] if isinstance(parameters[0], dict) else {}
+        descriptor = (parameters[1] if len(parameters) > 1
+                      and isinstance(parameters[1], dict) else {})
+        self.dead_letter_ring.append((topic, meta, descriptor))
+        self._dead_letter_count += 1
+        self.ec_producer.update("dead_letter_count",
+                                self._dead_letter_count)
+
     def records(self, topic: str) -> list:
         ring = self.topic_rings.get(topic)
         return list(ring) if ring is not None else []
@@ -90,6 +127,11 @@ class Recorder(Actor):
         return list(self.topic_rings.keys())
 
     # -- telemetry views ---------------------------------------------------
+
+    def dead_letters(self) -> list:
+        """Newest-last (topic, meta, inputs-descriptor) tuples from the
+        fleet's dead-letter topics."""
+        return list(self.dead_letter_ring)
 
     def metrics_sources(self) -> list:
         return list(self.metrics_snapshots.keys())
@@ -118,4 +160,6 @@ class Recorder(Actor):
                                     self.log_topic_pattern)
         self.remove_message_handler(self._metrics_handler,
                                     self.metrics_topic_pattern)
+        self.remove_message_handler(self._dead_letter_handler,
+                                    self.dead_letter_topic_pattern)
         super().stop()

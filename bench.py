@@ -50,6 +50,13 @@ TELEMETRY = os.environ.get("AIKO_BENCH_TELEMETRY", "1") != "0"
 _TRACE_PATH = None
 _TRACE_EVENTS: list = []
 _TRACE_DROPPED = 0
+# --faults <seed>: the serving config runs under a seeded 1%-frame
+# transient fault rate at the detector (on_error: retry recovers every
+# poisoned frame), publishing injected/retry/dead-letter counts in its
+# config block -- throughput under fault load becomes a measured number.
+# Without the flag every fault hook is one is-None check (the <2%
+# regression budget of the acceptance gate).
+_FAULTS_SEED = None
 
 ELEMENTS = "aiko_services_tpu.elements"
 
@@ -896,19 +903,38 @@ def bench_serving(peak):
                            jnp.float32)
         for index in range(4)]
 
+    fault_totals = {"injected": 0, "retries": 0, "dead_letters": 0,
+                    "frames_errored": 0}
+
     def run(micro):
+        pipeline_parameters = {"telemetry": TELEMETRY,
+                               "metrics_interval": 60.0}
+        detector_parameters = {"preset": preset,
+                               "micro_batch": micro,
+                               "dtype": ("float32" if SMOKE
+                                         else "bfloat16")}
+        if _FAULTS_SEED is not None:
+            # transient 1%-frame faults (each poisoned frame fails
+            # exactly once); the retry policy must recover every one or
+            # the response drain below hangs -- completion IS the gate.
+            # Telemetry is FORCED on: the retry/dead-letter counters in
+            # the published faults block come from it, and zeros under
+            # AIKO_BENCH_TELEMETRY=0 would read as silently lost frames
+            pipeline_parameters["telemetry"] = True
+            pipeline_parameters["faults"] = (
+                f"seed={_FAULTS_SEED};"
+                f"element_raise:node=detector:rate=0.01:once=1:times=-1")
+            detector_parameters.update(
+                {"on_error": "retry", "max_retries": 3,
+                 "retry_backoff_ms": 1})
         definition = {
             "name": "bench_serving",
-            "parameters": {"telemetry": TELEMETRY,
-                           "metrics_interval": 60.0},
+            "parameters": pipeline_parameters,
             "graph": ["(detector)"],
             "elements": [
                 {"name": "detector", "input": [{"name": "image"}],
                  "output": [{"name": "detections"}],
-                 "parameters": {"preset": preset,
-                                "micro_batch": micro,
-                                "dtype": ("float32" if SMOKE
-                                          else "bfloat16")},
+                 "parameters": detector_parameters,
                  "deploy": _local("Detector")},
             ],
         }
@@ -944,6 +970,17 @@ def bench_serving(peak):
             global _TRACE_DROPPED
             _TRACE_EVENTS.extend(pipeline.telemetry.chrome_events())
             _TRACE_DROPPED += pipeline.telemetry.tracer.dropped
+        if _FAULTS_SEED is not None:
+            stats = (pipeline.faults.stats()
+                     if pipeline.faults is not None else {})
+            registry = pipeline.telemetry.registry
+            fault_totals["injected"] += stats.get("element_raise", 0)
+            fault_totals["retries"] += registry.counter(
+                "pipeline.retries").value
+            fault_totals["dead_letters"] += registry.counter(
+                "pipeline.dead_letters").value
+            fault_totals["frames_errored"] += registry.counter(
+                "pipeline.frames_errored").value
         process.terminate()
         return total / elapsed
 
@@ -970,9 +1007,16 @@ def bench_serving(peak):
     med_coalesced = float(np.median(fps_coalesced))
     med_single = float(np.median(fps_single))
     flops = detector_flops_per_image(config)
+    faults_block = (
+        {"faults": {"seed": _FAULTS_SEED,
+                    "spec": "element_raise detector rate=0.01 once",
+                    "telemetry_forced": not TELEMETRY,
+                    **fault_totals}}
+        if _FAULTS_SEED is not None else {})
     return {
         "streams": streams_n,
         "telemetry": TELEMETRY,
+        **faults_block,
         "frames_per_sec_total": round(med_coalesced, 1),
         "coalesced_trials": [round(value, 1) for value in fps_coalesced],
         "coalesced_spread": [round(min(fps_coalesced), 1),
@@ -1118,14 +1162,22 @@ def _accelerator_failure(timeout: float = 120.0) -> str | None:
 
 
 def main() -> None:
-    global SMOKE, _TRACE_PATH
+    global SMOKE, _TRACE_PATH, _FAULTS_SEED
     argv = sys.argv[1:]
     if "--trace" in argv:
         index = argv.index("--trace")
         if index + 1 >= len(argv):
-            print("usage: bench.py [--trace <path>]", file=sys.stderr)
+            print("usage: bench.py [--trace <path>] [--faults <seed>]",
+                  file=sys.stderr)
             os._exit(2)
         _TRACE_PATH = argv[index + 1]
+    if "--faults" in argv:
+        index = argv.index("--faults")
+        if index + 1 >= len(argv):
+            print("usage: bench.py [--trace <path>] [--faults <seed>]",
+                  file=sys.stderr)
+            os._exit(2)
+        _FAULTS_SEED = int(argv[index + 1])
     platform = os.environ.get("AIKO_BENCH_PLATFORM")
     device_fallback = None
     if platform:
@@ -1216,6 +1268,8 @@ def main() -> None:
     }
     if device_fallback:
         result["device_fallback"] = device_fallback
+    if _FAULTS_SEED is not None:
+        result["faults_seed"] = _FAULTS_SEED  # self-describing A/B arm
     if _TRACE_PATH:
         # the trace artifact ships alongside the JSON: every benched
         # pipeline's frame spans in one Perfetto-loadable file

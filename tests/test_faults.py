@@ -1,0 +1,675 @@
+# Fault-tolerance suite (ISSUE 3): per-element error policies
+# (stop_stream | drop_frame | retry with backoff), dead-lettering on
+# {topic_path}/dead_letter, the per-stream error budget, frame
+# deadlines over parked branches, the fused-path circuit breaker, and
+# transfer-plane fetch retry -- all proven under the DETERMINISTIC
+# fault-injection harness (aiko_services_tpu/faults.py), so every
+# failure here is seeded and reproducible.
+
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu import faults as faults_module
+from aiko_services_tpu.pipeline import (
+    AsyncHostElement, DefinitionError, PipelineElement, StreamEvent,
+    StreamState, create_pipeline, parse_pipeline_definition)
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.transport import reset_brokers
+from aiko_services_tpu.utils import parse
+from helpers import wait_for
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    # each test declares its own plan (pipeline parameter or env);
+    # the cached AIKO_FAULTS plan must never leak between tests
+    faults_module.reset_injector()
+    reset_brokers()
+    yield
+    faults_module.reset_injector()
+    reset_brokers()
+
+
+class Scale(PipelineElement):
+    """x -> x*10, recording every call's leading (batch) size."""
+
+    def process_frame(self, stream, x):
+        stream.variables.setdefault("calls", []).append(int(x.shape[0]))
+        return StreamEvent.OKAY, {"y": x * 10.0}
+
+
+class BadKernelScale(Scale):
+    """Chained math works; the fused group kernel fails at RUN time
+    (inside the compiled-program trace) -- the fused-breaker shape."""
+
+    def group_kernel(self, stream):
+        def kernel(context, x):
+            raise RuntimeError("kernel exploded at trace time")
+
+        return kernel, ()
+
+
+class AsyncEcho(AsyncHostElement):
+    def process_async(self, stream, x):
+        return {"y": x}
+
+
+class ParkForever(PipelineElement):
+    def process_frame(self, stream, x):
+        return StreamEvent.PENDING, {}
+
+
+class Identity(PipelineElement):
+    def process_frame(self, stream, x):
+        return StreamEvent.OKAY, {"x": x}
+
+
+def _definition(micro_batch=1, class_name="Scale", element_params=None,
+                pipeline_params=None):
+    definition = {
+        "name": "fault_pipe",
+        "graph": ["(scale)"],
+        "elements": [
+            {"name": "scale", "input": [{"name": "x"}],
+             "output": [{"name": "y"}],
+             "parameters": {"micro_batch": micro_batch,
+                            **(element_params or {})},
+             "deploy": {"local": {"module": "tests.test_faults",
+                                  "class_name": class_name}}},
+        ],
+    }
+    if pipeline_params:
+        definition["parameters"] = dict(pipeline_params)
+    return definition
+
+
+RETRY_PARAMS = {"on_error": "retry", "max_retries": 3,
+                "retry_backoff_ms": 1}
+
+
+def _run_collect(definition, frames, expect, stream_params=None,
+                 timeout=30):
+    """Create the pipeline, queue `frames` before the loop starts,
+    collect `expect` responses.  Returns (outputs by frame_id, pipeline,
+    stream, process, dead_letters list)."""
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    dead_letters = []
+
+    def capture(topic, payload):
+        if topic.endswith("/dead_letter"):
+            dead_letters.append(parse(
+                payload if isinstance(payload, str) else str(payload)))
+
+    process.add_message_handler(capture, "#")
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses,
+                                    parameters=stream_params or {})
+    for frame_data in frames:
+        pipeline.create_frame(stream, frame_data)
+    process.run(in_thread=True)
+    got = {}
+    for _ in range(expect):
+        _, frame, outputs = responses.get(timeout=timeout)
+        got[frame.frame_id] = outputs
+    return got, pipeline, stream, process, dead_letters
+
+
+def _frames(count, shape=(2, 3)):
+    return [{"x": np.full(shape, float(index), np.float32)}
+            for index in range(count)]
+
+
+# -- the harness itself ------------------------------------------------------
+
+class TestFaultInjector:
+    def test_spec_parsing_and_counts(self):
+        injector = faults_module.create_injector(
+            "seed=5;element_raise:node=a:frame=2:times=1;fetch_drop")
+        assert injector.seed == 5
+        # frame-targeted rule: only (a, 2), consumed once
+        assert not injector.element_raise("a", 1)
+        assert not injector.element_raise("b", 2)
+        assert injector.element_raise_pending("a", 2)
+        assert injector.element_raise("a", 2)
+        assert not injector.element_raise("a", 2)  # times=1 consumed
+        assert injector.fetch_drop()
+        assert not injector.fetch_drop()
+        assert injector.stats() == {"element_raise": 1, "fetch_drop": 1}
+
+    def test_empty_spec_is_none(self):
+        assert faults_module.create_injector(None) is None
+        assert faults_module.create_injector("") is None
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults_module.create_injector("explode_everything")
+
+    def test_rate_selection_is_deterministic_in_seed(self):
+        spec = "seed=11;element_raise:node=n:rate=0.3:times=-1"
+        first = faults_module.create_injector(spec)
+        second = faults_module.create_injector(spec)
+        other = faults_module.create_injector(
+            "seed=12;element_raise:node=n:rate=0.3:times=-1")
+        picks = [{frame for frame in range(400)
+                  if injector.element_raise_pending("n", frame)}
+                 for injector in (first, second, other)]
+        assert picks[0] == picks[1]          # same seed, same frames
+        assert picks[0] != picks[2]          # seed changes the draw
+        assert 40 < len(picks[0]) < 200      # ~30% of 400
+
+    def test_rate_on_identityless_point_draws_per_call(self):
+        """fetch_drop has no frame identity: the per-rule call ordinal
+        must stand in, so rate= is a genuine per-call probability (not
+        an all-or-nothing constant) while staying seed-deterministic."""
+        spec = "seed=5;fetch_drop:rate=0.5:times=-1"
+        first = faults_module.create_injector(spec)
+        second = faults_module.create_injector(spec)
+        fires_a = [first.fetch_drop() for _ in range(200)]
+        fires_b = [second.fetch_drop() for _ in range(200)]
+        assert fires_a == fires_b           # deterministic in seed
+        assert 40 < sum(fires_a) < 160      # ~50% of 200
+        assert True in fires_a and False in fires_a
+
+    def test_once_rule_fires_once_per_frame(self):
+        injector = faults_module.create_injector(
+            "element_raise:node=n:rate=1.0:once=1:times=-1")
+        assert injector.element_raise("n", 7)
+        assert not injector.element_raise("n", 7)  # frame 7 already hit
+        assert injector.element_raise("n", 8)      # fresh frame still hit
+
+
+def test_on_error_grammar_validated_at_definition_time():
+    definition = _definition(element_params={"on_error": "explode"})
+    with pytest.raises(DefinitionError, match="on_error"):
+        parse_pipeline_definition(definition)
+
+
+# -- retry policy ------------------------------------------------------------
+
+def test_transient_fault_with_retry_is_bit_identical():
+    """The tentpole gate: a seeded transient element fault (frame 2
+    fails once) under `on_error: retry` yields BIT-IDENTICAL stream
+    output to the no-fault run, with zero destroyed streams."""
+    frames = _frames(6)
+    faulted, fault_pipe, fault_stream, p1, dead = _run_collect(
+        _definition(element_params=RETRY_PARAMS,
+                    pipeline_params={"faults": (
+                        "seed=11;element_raise:node=scale"
+                        ":frame=2:times=1")}),
+        frames, expect=6)
+    clean_got, _, _, p2, _ = _run_collect(
+        _definition(element_params=RETRY_PARAMS), frames, expect=6)
+    assert sorted(faulted) == sorted(clean_got) == list(range(6))
+    for index in range(6):
+        left = np.asarray(faulted[index]["y"])
+        right = np.asarray(clean_got[index]["y"])
+        assert left.dtype == right.dtype and left.shape == right.shape
+        assert left.tobytes() == right.tobytes()
+    # zero destroyed streams; the fault shows in telemetry, not output
+    assert "s1" in fault_pipe.streams
+    assert fault_stream.state == StreamState.RUN
+    registry = fault_pipe.telemetry.registry
+    assert registry.counter("pipeline.retries").value == 1
+    assert registry.counter("pipeline.frames_errored").value == 0
+    assert registry.counter("pipeline.dead_letters").value == 0
+    assert not dead
+    assert fault_pipe.faults.stats()["element_raise"] == 1
+    p1.terminate()
+    p2.terminate()
+
+
+def test_transient_fault_in_micro_batch_group_retries_transparently():
+    """A poisoned frame inside a coalesced group: the whole-group
+    attempts fail, isolation completes the siblings, and the poisoned
+    frame's retry re-enters the scheduler -- output still bit-identical
+    to the clean run."""
+    frames = _frames(4)
+    definition = _definition(micro_batch=4, element_params=RETRY_PARAMS,
+                             pipeline_params={"faults": (
+                                 "seed=3;element_raise:node=scale"
+                                 ":frame=1:times=1")})
+    faulted, pipeline, stream, p1, dead = _run_collect(
+        definition, frames, expect=4)
+    clean_got, _, _, p2, _ = _run_collect(
+        _definition(micro_batch=4, element_params=RETRY_PARAMS),
+        frames, expect=4)
+    for index in range(4):
+        assert (np.asarray(faulted[index]["y"]).tobytes()
+                == np.asarray(clean_got[index]["y"]).tobytes())
+    assert "s1" in pipeline.streams
+    assert not dead
+    assert pipeline.telemetry.registry.counter(
+        "pipeline.retries").value == 1
+    p1.terminate()
+    p2.terminate()
+
+
+# -- drop_frame + dead-lettering ---------------------------------------------
+
+def test_permanent_fault_drops_only_poisoned_frame_and_dead_letters():
+    """`on_error: drop_frame` with a PERMANENT fault on frame 1: the
+    sibling frames of the same micro-batch group complete, frame 1 is
+    dead-lettered with its trace id, and the stream survives."""
+    frames = _frames(4)
+    definition = _definition(
+        micro_batch=4,
+        element_params={"on_error": "drop_frame"},
+        pipeline_params={"faults": (
+            "seed=3;element_raise:node=scale:frame=1:times=-1")})
+    got, pipeline, stream, process, dead = _run_collect(
+        definition, frames, expect=3)
+    assert sorted(got) == [0, 2, 3]  # siblings completed
+    for index in (0, 2, 3):
+        value = np.asarray(got[index]["y"])
+        assert float(value[0, 0]) == index * 10
+    wait_for(lambda: dead)
+    command, parameters = dead[0]
+    assert command == "dead_letter"
+    meta, descriptor = parameters[0], parameters[1]
+    assert meta["node"] == "scale"
+    assert meta["reason"] == "drop_frame"
+    assert int(meta["frame_id"]) == 1
+    assert meta["trace_id"]  # joins the frame's trace
+    assert "injected fault" in meta["diagnostic"]
+    # inputs DESCRIPTOR, not payload: dtype + shape evidence
+    assert descriptor["x"] == "float32[2, 3]"
+    assert "s1" in pipeline.streams       # stream survived the poison
+    assert stream.state == StreamState.RUN
+    registry = pipeline.telemetry.registry
+    assert registry.counter("pipeline.dead_letters").value == 1
+    assert registry.counter("pipeline.frames_errored").value == 1
+    wait_for(lambda: stream.pending == 0)  # backpressure slot returned
+    process.terminate()
+
+
+def test_recorder_consumes_dead_letters():
+    from aiko_services_tpu.runtime import Recorder
+    recorder_process = Process(transport_kind="loopback")
+    recorder = Recorder(recorder_process)
+    recorder_process.run(in_thread=True)
+    definition = _definition(
+        element_params={"on_error": "drop_frame"},
+        pipeline_params={"faults":
+                         "element_raise:node=scale:frame=0:times=1"})
+    got, pipeline, stream, process, dead = _run_collect(
+        definition, _frames(2), expect=1)
+    assert sorted(got) == [1]  # frame 0 dead-lettered, frame 1 flowed
+    wait_for(lambda: recorder.dead_letters(), timeout=10)
+    topic, meta, descriptor = recorder.dead_letters()[0]
+    assert topic.endswith("/dead_letter")
+    assert meta["node"] == "scale" and meta["reason"] == "drop_frame"
+    assert descriptor["x"] == "float32[2, 3]"
+    recorder_process.terminate()
+    process.terminate()
+
+
+# -- error budget / stream quarantine ----------------------------------------
+
+def test_error_budget_quarantines_flapping_stream():
+    """drop_frame keeps a stream alive per failure -- but N errors
+    inside the sliding window must QUARANTINE it (destroyed with
+    StreamState.ERROR) instead of flapping forever."""
+    definition = _definition(
+        element_params={"on_error": "drop_frame"},
+        pipeline_params={"faults":
+                         "element_raise:node=scale:times=-1"})
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    stream = pipeline.create_stream(
+        "s1", queue_response=responses,
+        parameters={"error_budget": 3, "error_window": 30})
+    for frame_data in _frames(5):
+        pipeline.create_frame(stream, frame_data)
+    process.run(in_thread=True)
+    wait_for(lambda: "s1" not in pipeline.streams, timeout=15)
+    assert stream.state == StreamState.ERROR
+    registry = pipeline.telemetry.registry
+    assert registry.counter("pipeline.breaker_trips").value == 1
+    assert registry.counter("pipeline.dead_letters").value >= 3
+    assert responses.empty()
+    process.terminate()
+
+
+# -- frame deadline over parked branches -------------------------------------
+
+def test_frame_deadline_releases_blackholed_async_frame():
+    """A reply blackhole (a dead remote / lost async reply) parks the
+    frame forever; `frame_deadline` must release it as an error,
+    dead-lettered, with the stream surviving."""
+    definition = _definition(class_name="AsyncEcho",
+                             pipeline_params={"faults": (
+                                 "reply_blackhole:node=scale:times=1")})
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    dead = []
+    process.add_message_handler(
+        lambda topic, payload: dead.append(parse(str(payload)))
+        if topic.endswith("/dead_letter") else None, "#")
+    responses = queue.Queue()
+    stream = pipeline.create_stream(
+        "s1", queue_response=responses,
+        parameters={"frame_deadline": 0.4})
+    pipeline.create_frame(stream, {"x": np.ones((1, 2), np.float32)})
+    process.run(in_thread=True)
+    # the async reply is swallowed; the deadline must reap the frame
+    wait_for(lambda: not stream.frames, timeout=10)
+    assert stream.pending == 0          # backpressure slot reclaimed
+    assert "s1" in pipeline.streams     # frame-level error only
+    wait_for(lambda: dead)
+    meta = dead[0][1][0]
+    assert meta["reason"] == "frame_deadline"
+    assert pipeline.telemetry.registry.counter(
+        "pipeline.deadline_expired").value == 1
+    assert responses.empty()
+    process.terminate()
+
+
+def test_frame_deadline_does_not_kill_healthy_frames():
+    definition = _definition(class_name="AsyncEcho")
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    stream = pipeline.create_stream(
+        "s1", queue_response=responses,
+        parameters={"frame_deadline": 5.0})
+    pipeline.create_frame(stream, {"x": np.ones((1, 2), np.float32)})
+    process.run(in_thread=True)
+    _, frame, outputs = responses.get(timeout=10)
+    assert float(np.asarray(outputs["y"])[0, 0]) == 1.0
+    assert frame.deadline_lease is None  # terminated at finish
+    assert pipeline.telemetry.registry.counter(
+        "pipeline.deadline_expired").value == 0
+    process.terminate()
+
+
+# -- park watchdog telemetry (satellite) -------------------------------------
+
+def test_park_watchdog_expiry_counted_and_dead_lettered():
+    definition = {
+        "name": "watchdog_pipe",
+        "graph": ["(head (a) (b))"],
+        "elements": [
+            {"name": "head", "input": [{"name": "x"}],
+             "output": [{"name": "x"}],
+             "deploy": {"local": {"module": "tests.test_faults",
+                                  "class_name": "Identity"}}},
+            {"name": "a", "input": [{"name": "x"}],
+             "output": [{"name": "ya"}],
+             "deploy": {"local": {"module": "tests.test_faults",
+                                  "class_name": "ParkForever"}}},
+            {"name": "b", "input": [{"name": "x"}],
+             "output": [{"name": "yb"}],
+             "deploy": {"local": {"module": "tests.test_faults",
+                                  "class_name": "ParkForever"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream(
+        "s1", queue_response=responses,
+        parameters={"park_timeout": 0.2})
+    pipeline.process_frame({"stream_id": "s1"},
+                           {"x": np.ones((1, 1), np.float32)})
+    wait_for(lambda: 0 in stream.frames
+             and len(stream.frames[0].pending_nodes) == 2, timeout=10)
+    pipeline.process_frame_response(
+        {"stream_id": "s1", "frame_id": 0}, "")  # unroutable: arm
+    wait_for(lambda: not stream.frames, timeout=10)
+    registry = pipeline.telemetry.registry
+    assert registry.counter("pipeline.park_expired").value == 1
+    assert registry.counter("pipeline.dead_letters").value == 1
+    process.terminate()
+
+
+# -- fused-path circuit breaker ----------------------------------------------
+
+def test_fused_runtime_failure_retries_chained_then_breaker_pins():
+    """A group kernel failing at RUN time must not lose the group: it
+    retries on the chained path (frames complete).  After
+    FUSED_FLAP_LIMIT failures the breaker pins the element chained --
+    no more fused attempts, no more failures."""
+    from aiko_services_tpu.pipeline.pipeline import FUSED_FLAP_LIMIT
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(
+        process, _definition(micro_batch=2,
+                             class_name="BadKernelScale"))
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    process.run(in_thread=True)
+    for wave in range(FUSED_FLAP_LIMIT + 1):  # one wave per group
+        for index in range(2):
+            pipeline.create_frame(
+                stream,
+                {"x": np.full((1, 2), float(wave * 2 + index),
+                              np.float32)})
+        for _ in range(2):
+            _, frame, outputs = responses.get(timeout=30)
+            expected = frame.frame_id * 10.0
+            assert float(np.asarray(outputs["y"])[0, 0]) == expected
+    assert pipeline._fused_disabled == {"scale"}
+    registry = pipeline.telemetry.registry
+    # exactly the flap limit failed (the breaker then stopped fused
+    # attempts entirely -- regardless of how frames grouped)
+    assert registry.counter(
+        "pipeline.fused_failures").value == FUSED_FLAP_LIMIT
+    assert registry.counter("pipeline.fused_disabled").value == 1
+    # every group ultimately ran chained (process_frame saw them all)
+    assert len(stream.variables["calls"]) >= FUSED_FLAP_LIMIT
+    process.terminate()
+
+
+class StringErrorElement(PipelineElement):
+    """Contract edge: _safe_call only validates the StreamEvent half,
+    so a non-dict ERROR payload reaches the error handlers intact."""
+
+    def process_frame(self, stream, x):
+        return StreamEvent.ERROR, "plain text failure"
+
+
+def test_non_dict_error_payload_is_handled_not_leaked():
+    definition = _definition(class_name="StringErrorElement",
+                             element_params={"on_error": "drop_frame"})
+    got, pipeline, stream, process, dead = _run_collect(
+        definition, _frames(1), expect=0)
+    wait_for(lambda: dead, timeout=10)
+    assert dead[0][1][0]["diagnostic"] == "plain text failure"
+    wait_for(lambda: not stream.frames and stream.pending == 0)
+    assert "s1" in pipeline.streams  # frame released, stream alive
+    process.terminate()
+
+
+def test_singleton_group_consumes_fault_rule():
+    """A one-frame micro-batch group must CONSUME a times=1 fault (it
+    goes straight to the error policy with no isolation pass): the next
+    frame flows clean instead of the peeked rule poisoning forever."""
+    definition = _definition(
+        micro_batch=4,
+        element_params={"on_error": "drop_frame"},
+        pipeline_params={"faults": "element_raise:node=scale:times=1"})
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    process.run(in_thread=True)
+    # loop running: each frame parks alone and flushes as a singleton
+    pipeline.create_frame(stream, {"x": np.ones((1, 2), np.float32)})
+    wait_for(lambda: pipeline.telemetry.registry.counter(
+        "pipeline.dead_letters").value == 1, timeout=10)
+    pipeline.create_frame(stream, {"x": np.ones((1, 2), np.float32)})
+    _, frame, outputs = responses.get(timeout=10)  # second frame flows
+    assert float(np.asarray(outputs["y"])[0, 0]) == 10.0
+    assert pipeline.faults.stats() == {"element_raise": 1}
+    process.terminate()
+
+
+class FlakyKernelScale(Scale):
+    """Fused kernel failure steerable per group: `fail_next` is
+    captured at group_kernel time (fresh closure per call, so every
+    group rebuilds + re-traces)."""
+
+    fail_next = False
+
+    def group_kernel(self, stream):
+        def kernel(context, x, _fail=self.fail_next):
+            if _fail:
+                raise RuntimeError("flaky kernel")
+            return {"y": x * 10.0}
+
+        return kernel, ()
+
+
+def test_fused_breaker_resets_on_healthy_group():
+    """Only CONSECUTIVE fused failures trip the breaker: a healthy
+    fused group in between resets the flap count, so scattered poison
+    frames over a long deployment never pin a healthy kernel."""
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(
+        process, _definition(micro_batch=2,
+                             class_name="FlakyKernelScale"))
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s1", queue_response=responses)
+    process.run(in_thread=True)
+    element = pipeline.elements["scale"]
+    frame_value = [0]
+
+    def wave(fail):
+        # ONE frame per wave: with the loop running it flushes as one
+        # singleton group, so each wave is exactly one fused attempt
+        element.fail_next = fail
+        pipeline.create_frame(
+            stream, {"x": np.full((1, 2), float(frame_value[0]),
+                                  np.float32)})
+        frame_value[0] += 1
+        responses.get(timeout=30)
+
+    for fail in (True, False, True, True):  # fail, reset, fail x2
+        wave(fail)
+    # 3 total failures but never 3 CONSECUTIVE: breaker must not trip
+    assert "scale" not in pipeline._fused_disabled
+    registry = pipeline.telemetry.registry
+    assert registry.counter("pipeline.fused_failures").value == 3
+    assert registry.counter("pipeline.fused_disabled").value == 0
+    process.terminate()
+
+
+# -- transfer plane ----------------------------------------------------------
+
+def test_fetch_survives_one_injected_socket_drop(monkeypatch):
+    """`transfer.fetch` retries an injected socket drop; the
+    fetch_errors / fetch_retries counters reconcile (every failed
+    attempt was retried and recovered)."""
+    from aiko_services_tpu.observe.metrics import get_registry
+    from aiko_services_tpu.pipeline.transfer import (
+        TensorTransferServer, fetch)
+    monkeypatch.setenv("AIKO_FAULTS", "fetch_drop:times=1")
+    monkeypatch.setenv("AIKO_TRANSFER_RETRY_MS", "1")
+    faults_module.reset_injector()
+    registry = get_registry()
+    errors0 = registry.counter("transfer.fetch_errors").value
+    retries0 = registry.counter("transfer.fetch_retries").value
+    fetches0 = registry.counter("transfer.fetches").value
+    server = TensorTransferServer()
+    try:
+        array = np.arange(64, dtype=np.float32).reshape(8, 8)
+        fetched = fetch(server.offer(array))
+        np.testing.assert_array_equal(fetched, array)
+    finally:
+        server.close()
+    assert registry.counter(
+        "transfer.fetch_errors").value - errors0 == 1
+    assert registry.counter(
+        "transfer.fetch_retries").value - retries0 == 1
+    assert registry.counter("transfer.fetches").value - fetches0 == 1
+
+
+def test_fetch_exhausted_retries_still_raise(monkeypatch):
+    from aiko_services_tpu.pipeline.transfer import (
+        TensorTransferServer, TransferError, fetch)
+    monkeypatch.setenv("AIKO_FAULTS", "fetch_drop:times=-1")
+    monkeypatch.setenv("AIKO_TRANSFER_RETRY_MS", "1")
+    faults_module.reset_injector()
+    server = TensorTransferServer()
+    try:
+        descriptor = server.offer(np.ones(4))
+        with pytest.raises(TransferError, match="attempts"):
+            fetch(descriptor, retries=2)
+    finally:
+        server.close()
+
+
+# -- dispatch delay (latency-shaped fault) -----------------------------------
+
+def test_dispatch_delay_injects_latency_not_errors():
+    definition = _definition(
+        element_params=RETRY_PARAMS,
+        pipeline_params={"faults":
+                         "dispatch_delay:node=scale:ms=80:times=1"})
+    start = time.monotonic()
+    got, pipeline, stream, process, dead = _run_collect(
+        definition, _frames(1), expect=1)
+    elapsed = time.monotonic() - start
+    assert float(np.asarray(got[0]["y"])[0, 0]) == 0.0
+    assert elapsed >= 0.08  # the delay really ran
+    assert not dead
+    assert pipeline.faults.stats() == {"dispatch_delay": 1}
+    process.terminate()
+
+
+# -- generator-side policy ---------------------------------------------------
+
+class FlakyNumberSource(PipelineElement):
+    def process_frame(self, stream, **inputs):
+        return StreamEvent.OKAY, {}
+
+    def start_stream(self, stream, stream_id):
+        # own emission counter: the engine-side frame_id cursor advances
+        # on the event-loop thread, racing a fast generator
+        def generator(stream, frame_id):
+            emitted = stream.variables.get("emitted", 0)
+            if emitted == 1 and not stream.variables.get("tripped"):
+                stream.variables["tripped"] = True
+                raise RuntimeError("transient ingest hiccup")
+            if emitted >= 3:
+                return StreamEvent.STOP, None
+            stream.variables["emitted"] = emitted + 1
+            return StreamEvent.OKAY, {
+                "x": np.full((1, 1), float(emitted), np.float32)}
+
+        self.create_frames(stream, generator, rate=200)
+        return StreamEvent.OKAY, None
+
+
+def test_generator_fault_with_drop_policy_keeps_stream_alive():
+    """A transient frame-generator exception under `on_error:
+    drop_frame` skips the tick instead of destroying the stream (the
+    historical stop_stream default is unchanged elsewhere)."""
+    definition = {
+        "name": "gen_pipe",
+        "graph": ["(source (scale))"],
+        "elements": [
+            {"name": "source", "output": [{"name": "x"}],
+             "parameters": {"on_error": "drop_frame"},
+             "deploy": {"local": {"module": "tests.test_faults",
+                                  "class_name": "FlakyNumberSource"}}},
+            {"name": "scale", "input": [{"name": "x"}],
+             "output": [{"name": "y"}],
+             "deploy": {"local": {"module": "tests.test_faults",
+                                  "class_name": "Scale"}}},
+        ],
+    }
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s1", queue_response=responses)
+    got = sorted(float(np.asarray(responses.get(timeout=10)[2]["y"])[0, 0])
+                 for _ in range(3))
+    assert got == [0.0, 10.0, 20.0]  # frames 0..2 all delivered
+    process.terminate()

@@ -306,6 +306,73 @@ class TestProcessOverMqtt:
         worker.terminate()
         registrar_process.terminate()
 
+class TestMiniMqttReconnect:
+    """The reconnect path over REAL sockets, driven by the fault
+    harness: an injected abnormal connection drop must advance the
+    mqtt.reconnects counter and replay both subscriptions and the
+    last-will on the NEW session (the will is re-armed at CONNECT, so a
+    second drop fires it again)."""
+
+    def test_injected_drop_replays_subscriptions_and_lwt(self):
+        from aiko_services_tpu import faults as faults_module
+        from aiko_services_tpu.observe.metrics import get_registry
+        injector = faults_module.create_injector(
+            "connection_drop:times=2")
+        registry = get_registry()
+        reconnects0 = registry.counter("mqtt.reconnects").value
+
+        received = []
+        watcher = make_transport(
+            "socket",
+            lambda topic, payload: received.append((topic, payload)))
+        watcher.connect()
+        watcher.subscribe("ns/#")
+        client = make_transport(
+            "socket",
+            lambda topic, payload: received.append((topic, payload)))
+        client.set_last_will_and_testament("ns/x/state", "(absent)")
+        client.connect()
+        client.subscribe("ns/data")
+        drain("socket")
+        broker = _socket_broker()
+
+        # injected drop 1: abnormal socket loss; the network loop must
+        # reconnect (0.5 s backoff) and count it
+        assert injector.connection_drop()
+        broker.drop_client(client._client._client_id)
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and registry.counter(
+                   "mqtt.reconnects").value <= reconnects0):
+            time.sleep(0.05)
+        assert registry.counter(
+            "mqtt.reconnects").value > reconnects0, "drop not counted"
+        while time.monotonic() < deadline and not client.connected:
+            time.sleep(0.05)
+        assert client.connected, "client never reconnected"
+
+        # subscriptions replayed on the new session
+        received.clear()
+        client.publish("ns/data", "after-reconnect")
+        drain("socket")
+        assert ("ns/data", "after-reconnect") in received
+
+        # the last-will was re-armed at reconnect: injected drop 2
+        # fires it again on the NEW session
+        received.clear()
+        assert injector.connection_drop()
+        broker.drop_client(client._client._client_id)
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and ("ns/x/state", "(absent)") not in received):
+            time.sleep(0.05)
+        assert ("ns/x/state", "(absent)") in received
+        assert not injector.connection_drop()  # plan fully consumed
+        assert injector.stats() == {"connection_drop": 2}
+        watcher.disconnect()
+        client.disconnect()
+
+
 class TestMiniMqttClientUnit:
     """ADVICE r4 (low x2): CONNECT advertises the real keepalive, and
     flush() waits for its OWN ping's response."""

@@ -265,6 +265,64 @@ class TestPipelineRemoteHop:
 
 
 class TestTransferHardening:
+    def test_accept_loop_restarts_after_listener_death(self):
+        """An UNEXPECTED listener-socket death (injected here by closing
+        it out from under accept) must restart the accept loop on the
+        SAME port: outstanding descriptors bake in (host, port), so a
+        dead listener would otherwise turn every later fetch into a
+        dropped frame."""
+        from aiko_services_tpu.observe.metrics import get_registry
+        server = TensorTransferServer()
+        try:
+            array = np.arange(256, dtype=np.float32)
+            descriptor = server.offer(array)
+            restarts0 = get_registry().counter(
+                "transfer.listener_restarts").value
+            server._listener.close()  # injected listener death
+            deadline = time.monotonic() + 10
+            fetched = None
+            while time.monotonic() < deadline:
+                try:
+                    fetched = fetch(descriptor, timeout=2.0, retries=0)
+                    break
+                except ValueError:  # TransferError: not yet restarted
+                    time.sleep(0.05)
+            assert fetched is not None, "listener never came back"
+            np.testing.assert_array_equal(fetched, array)
+            assert get_registry().counter(
+                "transfer.listener_restarts").value == restarts0 + 1
+        finally:
+            server.close()
+
+    def test_reset_then_get_recreates_singleton_after_listener_death(self):
+        """close -> get -> fetch: reset_transfer_server leaves a closed
+        singleton behind; get_transfer_server must hand back a LIVE
+        replacement whose fetches work, even after the previous
+        instance's listener died abnormally."""
+        from aiko_services_tpu.pipeline.transfer import (
+            get_transfer_server)
+        reset_transfer_server()
+        first = get_transfer_server()
+        first._listener.close()   # injected death, then deliberate close
+        reset_transfer_server()
+        second = get_transfer_server()
+        try:
+            assert second is not first and not second._closed
+            array = np.arange(64, dtype=np.int32)
+            descriptor = second.offer(array)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    np.testing.assert_array_equal(
+                        fetch(descriptor, timeout=2.0), array)
+                    break
+                except ValueError:
+                    time.sleep(0.05)
+            else:
+                raise AssertionError("recreated server never served")
+        finally:
+            reset_transfer_server()
+
     def test_fetched_array_is_writable(self):
         server = TensorTransferServer()
         try:
