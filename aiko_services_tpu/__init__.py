@@ -15,6 +15,7 @@
 #   runtime/   L2-L8 event engine, process, service, actor, share, registrar
 #   observe/   telemetry: metrics registry, frame tracer, live export
 #   pipeline/  L9 pipeline engine: streams, frames, elements, graphs
+#   serve/     L10 serving tier: gateway (admission, routing, failover)
 #   ops/       TPU ops: attention, mel spectrogram, image, pallas kernels
 #   parallel/  mesh management, sharding specs, collectives, ring attention
 #   models/    flagship model families: LLM (Llama-style), Whisper, YOLO

@@ -15,6 +15,10 @@
 #   dispatch_delay   extra host latency before an element dispatch
 #   connection_drop  an MQTT connection is severed abnormally (consumed
 #                    by tests driving the embedded broker)
+#   replica_kill     a serving-gateway replica dies abnormally (consumed
+#                    by the gateway per routed frame: node= targets the
+#                    replica by name, frame=k kills it on the k-th frame
+#                    routed to it)
 #
 # Determinism contract: rate-based selection hashes (seed, point, node,
 # frame_id) -- the SAME frames are poisoned on every run with the same
@@ -59,7 +63,7 @@ __all__ = ["FaultInjector", "create_injector", "get_injector",
            "reset_injector"]
 
 _POINTS = ("element_raise", "fetch_drop", "reply_blackhole",
-           "dispatch_delay", "connection_drop")
+           "dispatch_delay", "connection_drop", "replica_kill")
 
 
 class _Rule:
@@ -205,6 +209,16 @@ class FaultInjector:
 
     def connection_drop(self) -> bool:
         return self._fire("connection_drop") is not None
+
+    def replica_kill(self, replica) -> bool:
+        """Consume: should `replica` die now?  Consulted by the serving
+        gateway once per frame ROUTED to that replica, so `frame=k`
+        kills the replica on its k-th routed frame (0-based, the
+        per-rule call ordinal) and `rate=` draws once per routed
+        frame.  The node filter keeps other replicas' traffic from
+        consuming the rule's ordinal (same determinism contract as
+        element_raise)."""
+        return self._fire("replica_kill", replica) is not None
 
     def stats(self) -> dict:
         with self._lock:

@@ -11,3 +11,4 @@ from .metrics import (                                      # noqa: F401
 from .trace import (                                        # noqa: F401
     FrameTrace, Tracer, chrome_trace_document)
 from .telemetry import PipelineTelemetry                    # noqa: F401
+from .gateway import GatewayTelemetry                       # noqa: F401

@@ -1,0 +1,103 @@
+# GatewayTelemetry: the serving gateway's observability seam.
+#
+# Mirrors PipelineTelemetry's shape (one registry per gateway, hot-path
+# instrument handles resolved once, a periodic snapshot publish on
+# `{topic_path}/metrics` plus a compact EC-share summary) but records
+# the SERVING-TIER vocabulary: admission decisions (admitted / shed,
+# per priority), routing (frames routed, per-replica), backpressure
+# (parked queue depth per priority, throttle transitions), and
+# failover (replica deaths, streams migrated).  The admitted-latency
+# histogram measures submit -> response through the whole tier -- the
+# number an SLO is written against.
+
+from __future__ import annotations
+
+from ..utils import get_logger
+from .metrics import MetricsRegistry
+
+__all__ = ["GatewayTelemetry"]
+
+_LOGGER = get_logger("gateway_telemetry")
+
+DEFAULT_METRICS_INTERVAL = 10.0
+
+
+class GatewayTelemetry:
+    def __init__(self, gateway, enabled: bool = True,
+                 interval: float = DEFAULT_METRICS_INTERVAL):
+        self.gateway = gateway
+        self.enabled = enabled
+        self.registry = MetricsRegistry()
+        registry = self.registry
+        self.admitted = registry.counter("gateway.admitted")
+        self.shed_streams = registry.counter("gateway.shed_streams")
+        self.shed_frames = registry.counter("gateway.shed_frames")
+        self.routed = registry.counter("gateway.routed")
+        self.completed = registry.counter("gateway.completed")
+        self.released = registry.counter("gateway.released")
+        self.duplicates = registry.counter("gateway.duplicates")
+        self.throttled = registry.counter("gateway.throttled")
+        self.unthrottled = registry.counter("gateway.unthrottled")
+        self.failovers = registry.counter("gateway.failovers")
+        self.replica_deaths = registry.counter("gateway.replica_deaths")
+        self.replicas = registry.gauge("gateway.replicas")
+        self.parked = registry.gauge("gateway.parked")
+        self.latency = registry.histogram("gateway.admit_latency_s")
+        self._interval = interval
+        self._timer = None
+        if self.enabled and interval > 0:
+            self._timer = self._publish_snapshot
+            gateway.process.event.add_timer_handler(self._timer, interval)
+
+    def record_queue_depths(self, depths: dict) -> None:
+        """Parked-queue occupancy PER PRIORITY (gauge family
+        `gateway.queue_depth:p{n}`): overload triage needs to see WHICH
+        priorities are waiting, not only the total."""
+        if not self.enabled:
+            return
+        for priority, depth in depths.items():
+            self.registry.gauge(
+                f"gateway.queue_depth:p{priority}").set(depth)
+
+    def record_replica_routed(self, replica_name: str) -> None:
+        if not self.enabled:
+            return
+        self.registry.counter(f"gateway.routed:{replica_name}").inc()
+
+    def snapshot(self) -> dict:
+        return self.registry.snapshot()
+
+    def summary(self) -> dict:
+        """Compact scalars for the EC share / dashboards."""
+        return {
+            "admitted": self.admitted.value,
+            "shed_streams": self.shed_streams.value,
+            "shed_frames": self.shed_frames.value,
+            "routed": self.routed.value,
+            "completed": self.completed.value,
+            "released": self.released.value,
+            "throttled": self.throttled.value,
+            "failovers": self.failovers.value,
+            "replica_deaths": self.replica_deaths.value,
+            "replicas": self.replicas.value,
+            "parked": self.parked.value,
+        }
+
+    def _publish_snapshot(self) -> None:
+        gateway = self.gateway
+        try:
+            from ..utils import generate
+            gateway.process.publish(
+                f"{gateway.topic_path}/metrics",
+                generate("metrics",
+                         [gateway.topic_path, self.snapshot()]))
+            if gateway.ec_producer is not None:
+                gateway.ec_producer.update("metrics", self.summary())
+        except Exception as error:  # export must never kill the gateway
+            _LOGGER.warning("gateway metrics publish failed: %s", error)
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self.gateway.process.event.remove_timer_handler(self._timer)
+            self._timer = None
+            self._publish_snapshot()

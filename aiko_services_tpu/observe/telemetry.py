@@ -50,6 +50,8 @@ class PipelineTelemetry:
         self._timer = None
         # hot-path instrument handles resolved ONCE: per-frame hooks do
         # an attribute read + int add / bisect, never a name lookup
+        # (the load heartbeat below is timer-driven, so `telemetry:
+        # false` still means ZERO per-frame writes)
         registry = self.registry
         self._frames_total = registry.counter("pipeline.frames_total")
         self._frames_dropped = registry.counter(
@@ -61,8 +63,13 @@ class PipelineTelemetry:
             "pipeline.chained_groups")
         self._element_hists: dict = {}
         self._queue_hists: dict = {}
-        if self.enabled and self._interval > 0:
-            self._timer = self._publish_snapshot
+        if self._interval > 0:
+            # with telemetry off only the cheap load heartbeat runs:
+            # serving gateways age a replica's EC share (`stale_after`)
+            # and would otherwise permanently distrust a healthy but
+            # idle telemetry-disabled replica
+            self._timer = (self._publish_snapshot if self.enabled
+                           else self._publish_load)
             pipeline.process.event.add_timer_handler(
                 self._timer, self._interval)
 
@@ -227,6 +234,16 @@ class PipelineTelemetry:
                           {"pending": sorted(str(n) for n
                                              in frame.pending_nodes)})
 
+    def record_stream_collision(self, stream_id: str) -> None:
+        """create_stream hit an already-registered stream_id with
+        DIFFERENT parameters: the caller got the existing stream, not
+        one configured as requested -- counted so id-allocation bugs
+        upstream (two clients minting the same id) surface in metrics,
+        not only in one warning line."""
+        if not self.enabled:
+            return
+        self.registry.counter("pipeline.stream_id_collision").inc()
+
     def record_breaker_trip(self, stream_id: str) -> None:
         """A stream blew its error budget and was quarantined."""
         if not self.enabled:
@@ -301,8 +318,13 @@ class PipelineTelemetry:
         return get_registry().snapshot()
 
     def summary(self) -> dict:
-        """Compact scalars for the EC share / dashboard plugin."""
+        """Compact scalars for the EC share / dashboard plugin.  The
+        `load` sub-dict is the serving gateway's periodic load gauge: a
+        remote gateway admits/routes against these numbers (refreshed
+        every metrics_interval) between the create/destroy-time share
+        updates."""
         return {
+            "load": self.pipeline.load(),
             "frames": self._frames_total.value,
             "dropped": self._frames_dropped.value,
             "errors": self._frames_errored.value,
@@ -339,15 +361,41 @@ class PipelineTelemetry:
                     f"{pipeline.process.hostname}/{os.getpid()}/process",
                     self.process_snapshot()]))
             if pipeline.ec_producer is not None:
-                pipeline.ec_producer.update("metrics", self.summary())
+                summary = self.summary()
+                pipeline.ec_producer.update("metrics", summary)
+                # top-level scalars as well: the serving gateway's
+                # ECConsumer mirror reads plain `inflight` /
+                # `queue_depth` keys (nested dicts are awkward over the
+                # EC wire), refreshed here between stream-churn updates
+                load = summary.get("load") or {}
+                pipeline.ec_producer.update(
+                    "inflight", load.get("inflight", 0))
+                pipeline.ec_producer.update(
+                    "queue_depth", load.get("queue_depth", 0))
         except Exception as error:  # export must never kill the engine
             _LOGGER.warning("metrics publish failed: %s", error)
+
+    def _publish_load(self) -> None:
+        """The telemetry-disabled heartbeat: refresh ONLY the EC share
+        load scalars (no registry snapshot, no tracing, nothing
+        per-frame touched)."""
+        pipeline = self.pipeline
+        try:
+            if pipeline.ec_producer is not None:
+                load = pipeline.load()
+                pipeline.ec_producer.update(
+                    "inflight", load.get("inflight", 0))
+                pipeline.ec_producer.update(
+                    "queue_depth", load.get("queue_depth", 0))
+        except Exception as error:
+            _LOGGER.warning("load heartbeat failed: %s", error)
 
     def stop(self) -> None:
         if self._timer is not None:
             self.pipeline.process.event.remove_timer_handler(self._timer)
             self._timer = None
-            self._publish_snapshot()  # final flush: no stale last-window
+            if self.enabled:
+                self._publish_snapshot()  # final flush: no stale window
 
     # -- trace export ------------------------------------------------------
 

@@ -62,6 +62,11 @@ class FrameGeneratorHandle:
         self.rate = rate
         self.frame_window = frame_window
         self._terminated = False
+        # downstream backpressure (serving gateway `(throttle ...)`
+        # control message): a positive override CAPS the generation rate
+        # below the configured one; 0/None lifts the cap.  Read each
+        # tick so a throttle lands mid-stream without a restart.
+        self._rate_cap: float | None = None
         self._thread = threading.Thread(
             target=self._run,
             name=f"frames-{element.name}-{stream.stream_id}", daemon=True)
@@ -72,10 +77,27 @@ class FrameGeneratorHandle:
     def terminate(self):
         self._terminated = True
 
+    def set_rate(self, rate) -> None:
+        """Cap the generation rate (frames/sec); rate <= 0 lifts the
+        cap back to the configured rate.  Thread-safe: the generator
+        loop re-reads the effective interval every tick."""
+        try:
+            rate = float(rate)
+        except (TypeError, ValueError):
+            return
+        self._rate_cap = rate if rate > 0 else None
+
+    def _interval(self) -> float:
+        rate = self.rate
+        cap = self._rate_cap
+        if cap is not None and (not rate or cap < rate):
+            rate = cap
+        return 1.0 / rate if rate else 0.0
+
     def _run(self):
         pipeline = self.element.pipeline
         stream = self.stream
-        interval = 1.0 / self.rate if self.rate else 0.0
+        interval = self._interval()
         next_time = time.monotonic()
         while not self._terminated and stream.state == StreamState.RUN:
             # backpressure: bound in-flight frames so a fast generator
@@ -83,6 +105,12 @@ class FrameGeneratorHandle:
             if stream.pending >= self.frame_window:
                 time.sleep(0.0005)
                 continue
+            effective = self._interval()
+            if effective != interval:
+                # a throttle landed (or lifted): clamp the schedule to
+                # now so a long idle gap is not "owed" as a burst
+                interval = effective
+                next_time = time.monotonic()
             try:
                 stream_event, frame_data = self.frame_generator(
                     stream, stream.frame_id)
@@ -191,6 +219,16 @@ class PipelineElement(Actor):
         handle = self._generators.pop(stream_id, None)
         if handle:
             handle.terminate()
+
+    def throttle_frame_generation(self, stream_id, rate) -> None:
+        """Backpressure sibling of stop_frame_generation: cap this
+        stream's generator at `rate` frames/sec (rate <= 0 lifts the
+        cap).  Driven by the serving gateway's `(throttle stream rate)`
+        control message when downstream replicas saturate -- a slowed
+        source beats a shed frame."""
+        handle = self._generators.get(stream_id)
+        if handle:
+            handle.set_rate(rate)
 
     # -- parameters (reference pipeline.py:422-456) ------------------------
 

@@ -331,7 +331,26 @@ class Pipeline(Actor):
                       first_frame_id: int = 0) -> Stream | None:
         stream_id = str(stream_id)
         if stream_id in self.streams:
-            return self.streams[stream_id]
+            existing = self.streams[stream_id]
+            if isinstance(parameters, str):
+                try:
+                    parameters = (json.loads(parameters)
+                                  if parameters else {})
+                except ValueError:
+                    parameters = None
+            if parameters and dict(parameters) != existing.parameters:
+                # the caller gets the EXISTING stream, configured under
+                # the FIRST parameter set -- silent reuse here has
+                # masked id-allocation bugs (two clients minting the
+                # same id with different configs); name both sets so
+                # the losing caller's missing knobs are attributable
+                _LOGGER.warning(
+                    "%s: create_stream(%s) collided with a live stream;"
+                    " keeping existing parameters %r, ignoring %r",
+                    self.name, stream_id, existing.parameters,
+                    dict(parameters))
+                self.telemetry.record_stream_collision(stream_id)
+            return existing
         try:
             if isinstance(parameters, str):  # wire call: JSON-encoded
                 parameters = json.loads(parameters) if parameters else {}
@@ -341,6 +360,14 @@ class Pipeline(Actor):
             _LOGGER.warning("%s: bad create_stream arguments: %s",
                             self.name, error)
             return None
+        # wire placeholders: the sexpr codec renders None as an empty
+        # list, so positional wire calls (e.g. the serving gateway's
+        # create_stream with first_frame_id) deliver [] for the slots
+        # they skip -- a falsy responder/path means "not provided"
+        if not queue_response:
+            queue_response = None
+        if not graph_path:
+            graph_path = None
         if graph_path and str(graph_path) not in self.graph:
             # validate BEFORE registering: a bad head must not leave a
             # half-created stream holding a lease
@@ -357,7 +384,8 @@ class Pipeline(Actor):
         self.streams[stream_id] = stream
         self._stream_leases[stream_id] = Lease(
             self.process.event, grace_time, stream_id,
-            lease_expired_handler=self._stream_lease_expired)
+            lease_expired_handler=self._stream_lease_expired,
+            jitter=self._lease_jitter(stream_id))
         # Remote streams FIRST: a local DataSource may start generating
         # frames the moment start_stream returns, and those frames must not
         # reach a remote pipeline before its create_stream does.
@@ -427,6 +455,16 @@ class Pipeline(Actor):
         # callers synchronize on stream removal
         self.streams.pop(stream_id, None)
         self._update_stream_share()
+
+    def _lease_jitter(self, stream_id: str) -> float:
+        """Deterministic per-stream timer jitter decorrelating
+        stream-lease expiry checks (thousands of streams created in one
+        burst must not tick in lockstep).  Seeded by the fault harness
+        (its seed, else 0) so fault-scenario runs reproduce the exact
+        timer schedule."""
+        from ..runtime.lease import jitter_fraction
+        seed = self.faults.seed if self.faults is not None else 0
+        return jitter_fraction(seed, stream_id)
 
     def _stream_lease_expired(self, stream_id) -> None:
         _LOGGER.info("%s: stream %s lease expired", self.name, stream_id)
@@ -1820,10 +1858,57 @@ class Pipeline(Actor):
         if element is not None and not isinstance(element, RemoteElement):
             element.set_parameter(name, value)
 
+    def load(self) -> dict:
+        """Instantaneous load summary: `inflight` frames admitted but
+        not finished (across streams), `queue_depth` frames parked in
+        the micro-batch scheduler awaiting a coalesced flush, and the
+        live stream count.  Cheap enough to read per routed frame: the
+        serving gateway's replica selection (power-of-two-choices) and
+        admission caps consume exactly this dict -- locally for
+        in-process replicas, via the EC share (below, plus the periodic
+        telemetry summary) for remote ones."""
+        return {
+            "inflight": sum(
+                stream.pending for stream in self.streams.values()),
+            "queue_depth": sum(
+                len(entries) for entries in self._micro_pending.values()),
+            "streams": len(self.streams),
+        }
+
+    def throttle(self, stream_id, rate) -> None:
+        """Wire-invocable backpressure: cap `stream_id`'s frame
+        generators at `rate` frames/sec (rate <= 0 lifts the cap).
+        Sent by the serving gateway as `(throttle stream rate)` when
+        every replica saturates -- slowing the source beats shedding
+        its frames."""
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            return
+        rate = parse_float(rate, 0.0)
+        for node_name in self.graph.get_path(stream.graph_path):
+            element = self.elements[node_name]
+            if isinstance(element, RemoteElement):
+                element.call("throttle", [stream.stream_id, rate])
+            else:
+                element.throttle_frame_generation(stream.stream_id, rate)
+
     def _update_stream_share(self) -> None:
         if self.ec_producer is not None:
             self.ec_producer.update("stream_count", len(self.streams))
             self.ec_producer.update("frame_count", self._frame_count)
+            # refresh the load gauge consumed by serving gateways --
+            # but load() is O(streams + parked), so a creation BURST
+            # (thousands of streams, the lease-jitter scenario) must
+            # not go quadratic on the event loop: rate-limit to one
+            # refresh per 200 ms; the periodic telemetry heartbeat
+            # keeps it fresh between churn events anyway
+            now = time.monotonic()
+            if now - getattr(self, "_load_shared_at", 0.0) >= 0.2:
+                self._load_shared_at = now
+                load = self.load()
+                self.ec_producer.update("inflight", load["inflight"])
+                self.ec_producer.update("queue_depth",
+                                        load["queue_depth"])
 
     # -- checkpoint / resume (no reference counterpart: SURVEY.md section 5
     # "Checkpoint/resume: absent"; required for preemptible TPU recovery) --

@@ -6,15 +6,34 @@
 
 from __future__ import annotations
 
+import hashlib
+
 from ..utils import monotonic
 
-__all__ = ["Lease"]
+__all__ = ["Lease", "jitter_fraction", "DEFAULT_TIMER_JITTER"]
+
+# default spread for jittered lease timers: up to +10% of the period
+DEFAULT_TIMER_JITTER = 0.1
+
+
+def jitter_fraction(seed, lease_uuid,
+                    spread: float = DEFAULT_TIMER_JITTER,
+                    salt: str = "lease") -> float:
+    """Deterministic per-lease fraction in [0, spread) for the Lease
+    `jitter` parameter: a pure hash of (salt, seed, uuid), so runs
+    under the same fault-harness seed reproduce the exact timer
+    schedule while a burst of leases still spreads out (no
+    thundering-herd lockstep).  ONE definition, shared by the pipeline
+    stream leases and the serving gateway's stream records."""
+    digest = hashlib.blake2b(
+        f"{salt}:{seed}:{lease_uuid}".encode(), digest_size=8).digest()
+    return (int.from_bytes(digest, "big") / float(1 << 64)) * spread
 
 
 class Lease:
     def __init__(self, event_engine, lease_time: float, lease_uuid,
                  lease_expired_handler=None, lease_extend_handler=None,
-                 automatic_extend: bool = False):
+                 automatic_extend: bool = False, jitter: float = 0.0):
         self.event_engine = event_engine
         self.lease_time = lease_time
         self.lease_uuid = lease_uuid
@@ -32,6 +51,16 @@ class Lease:
         else:
             self._timer_period = lease_time
             self._timer = self._expiry_timer
+        # `jitter` stretches the TIMER PERIOD (never the deadline) by a
+        # caller-chosen fraction: thousands of leases created in one
+        # burst must not run their expiry checks in lockstep every
+        # period (a thundering herd on the event loop).  The deadline
+        # math is untouched, so expiry semantics only shift by at most
+        # one jittered period -- callers pass a DETERMINISTIC fraction
+        # (e.g. hashed from the lease uuid + harness seed) so runs
+        # reproduce exactly.
+        if jitter > 0.0:
+            self._timer_period *= 1.0 + jitter
         event_engine.add_timer_handler(self._timer, self._timer_period)
 
     def _automatic_extend_timer(self) -> None:

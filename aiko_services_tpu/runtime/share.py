@@ -172,6 +172,11 @@ class ECConsumer:
         self.filter_expression = filter_expression
         self.lease_time = lease_time
         self.synced = False
+        # monotonic timestamp of the LAST producer message (add/update/
+        # remove/sync): consumers that must distinguish "live mirror"
+        # from "stale snapshot of a wedged producer" (the serving
+        # gateway's replica load view) age entries against this
+        self.last_update: float | None = None
         self._expected_items = None
         self._change_handlers: list = []
         self.consumer_id = next(self._ids)
@@ -198,8 +203,9 @@ class ECConsumer:
         self._send_share_request()
 
     def _response_handler(self, topic: str, payload: str) -> None:
-        from ..utils import parse
+        from ..utils import parse, monotonic
         command, parameters = parse(payload)
+        self.last_update = monotonic()
         if command == "item_count" and parameters:
             self._expected_items = parse_number(parameters[0], 0)
         elif command in ("add", "update") and len(parameters) >= 2:

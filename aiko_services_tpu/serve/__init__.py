@@ -1,0 +1,10 @@
+# serve/: the serving tier -- a Gateway actor fronting a pool of
+# pipeline replicas with admission control (per-priority token buckets,
+# SLO-aware shedding), least-loaded routing (power-of-two-choices over
+# registrar-discovered replicas' EC load gauges), bounded backpressure
+# with `(throttle ...)` signals to DataSources, and mid-stream failover
+# that replays un-acknowledged frames on replica death.  See README
+# "Serving gateway".
+
+from .policy import AdmissionPolicy, TokenBucket          # noqa: F401
+from .gateway import Gateway, SERVICE_PROTOCOL_GATEWAY    # noqa: F401

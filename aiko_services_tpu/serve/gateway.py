@@ -1,0 +1,936 @@
+# Gateway: the serving tier in front of a pool of pipeline replicas.
+#
+# The ROADMAP north star is heavy traffic from millions of users; until
+# this subsystem, every client talked straight to ONE Pipeline actor,
+# `process_frame` admitted without limit, and overload meant unbounded
+# queue growth until the micro-batch scheduler drowned.  The Gateway
+# closes that gap with the load-shedding / least-loaded-routing designs
+# of datacenter inference frontends (Orca-style continuous-batching
+# routers, Clockwork's SLO-aware admission):
+#
+#   admission   per-priority token buckets gate STREAM creation; an
+#               over-budget or unplaceable stream gets a typed
+#               `(overloaded ...)` reply, never silent queue growth
+#   routing     power-of-two-choices over live load gauges picks the
+#               least-loaded healthy replica; a stream PINS to its
+#               replica for its lifetime (stateful elements, ordered
+#               frames)
+#   backpressure a bounded priority queue parks frames when the pinned
+#               replica saturates; past the high-water mark the gateway
+#               sends `(throttle stream rate)` so DataSources slow
+#               generation (PipelineElement.throttle_frame_generation);
+#               a full queue sheds the LOWEST-priority parked frame
+#   failover    replica death (discovery remove, or the seeded
+#               `replica_kill` fault point) migrates its streams to
+#               another replica and replays every un-acknowledged frame
+#               from the stream cursor -- zero lost frames, duplicate
+#               responses deduped, so outputs match an unfaulted run
+#               bit for bit
+#
+# Replicas come from two sources: `attach_replica(pipeline)` wires an
+# in-process Pipeline directly (responses hand off as Python objects,
+# no codec -- the bench/test fast path), and `discover(...)` watches
+# the registrar through the shared ServicesCache, mirroring each
+# replica's EC share (`inflight` / `queue_depth`, refreshed by
+# Pipeline._update_stream_share and the periodic telemetry summary)
+# through an ECConsumer whose `last_update` age gates trust in the
+# view (a wedged replica's stale share must not keep attracting
+# streams).
+#
+# The wire surface mirrors the Pipeline protocol (create_stream /
+# process_frame / destroy_stream), so pointing an existing client at a
+# gateway topic instead of a pipeline topic is a config change, not a
+# code change.
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..faults import create_injector, get_injector
+from ..observe import GatewayTelemetry
+from ..pipeline.pipeline import DEFAULT_GRACE_TIME
+from ..pipeline.tensors import decode_frame_data, encode_frame_data
+from ..runtime import Actor, Lease, ServiceFilter
+from ..runtime.service import PROTOCOL_PREFIX, SERVICE_PROTOCOL_PIPELINE
+from ..utils import generate, get_logger, parse, parse_float, parse_int
+from .policy import AdmissionPolicy
+
+__all__ = ["Gateway", "SERVICE_PROTOCOL_GATEWAY"]
+
+_LOGGER = get_logger("gateway")
+
+SERVICE_PROTOCOL_GATEWAY = f"{PROTOCOL_PREFIX}/gateway:0"
+# completion-rate estimator: SLO shedding stays off until this many
+# completions have been observed (a cold estimate would shed blindly)
+_RATE_WINDOW = 64
+_RATE_WARMUP = 8
+
+
+class _LocalResponder:
+    """queue_response shim handed to an in-process replica's stream:
+    successful frames hand off to the gateway mailbox as live Python
+    objects (no tensor codec on the fast path).  Error/drop releases
+    ride the stream's topic_response instead -- the pipeline engine
+    only notifies queue responders on success.
+
+    Responses ride the CONTROL mailbox: under overload the `in`
+    mailbox holds thousands of queued submissions, and a slot-freeing
+    release parked behind them would starve every replica (measured:
+    goodput collapsed to ~15% of capacity with FIFO ordering).  The
+    actor layer's control-preempts-data rule is exactly this
+    priority."""
+
+    __slots__ = ("gateway",)
+
+    def __init__(self, gateway):
+        self.gateway = gateway
+
+    def put(self, item) -> None:
+        from ..runtime import ActorTopic
+        stream, frame, outputs = item
+        self.gateway.post_message("process_frame_response", [
+            {"stream_id": stream.stream_id, "frame_id": frame.frame_id},
+            outputs], actor_topic=ActorTopic.CONTROL)
+
+
+class _Replica:
+    __slots__ = ("topic_path", "name", "pipeline", "consumer", "cache",
+                 "outstanding", "streams", "dead", "saturated",
+                 "below_since", "routed")
+
+    def __init__(self, topic_path: str, name: str, pipeline=None,
+                 consumer=None, cache=None):
+        self.topic_path = topic_path
+        self.name = name
+        self.pipeline = pipeline      # local direct attach (else None)
+        self.consumer = consumer      # ECConsumer for discovered replicas
+        self.cache = cache if cache is not None else {}
+        self.outstanding = 0          # gateway-routed frames in flight
+        self.streams: set[str] = set()
+        self.dead = False
+        self.saturated = False
+        self.below_since: float | None = None
+        self.routed = 0
+
+    def reported_inflight(self) -> int:
+        """The replica's OWN load claim: live for local replicas, the
+        EC share mirror for discovered ones."""
+        if self.pipeline is not None:
+            return int(self.pipeline.load()["inflight"])
+        return parse_int(self.cache.get("inflight", 0), 0)
+
+    def score(self) -> int:
+        """Routing load: the gateway's instant view of what it routed,
+        or the replica's own claim when other clients load it too --
+        max, never sum (the gateway's frames appear in both)."""
+        return max(self.outstanding, self.reported_inflight())
+
+    def fresh(self, now: float, stale_after: float) -> bool:
+        if self.consumer is None:
+            return True   # local: the load read IS the live value
+        last_update = self.consumer.last_update
+        return (last_update is not None
+                and (stale_after <= 0
+                     or now - last_update <= stale_after))
+
+    def note_load(self, now: float, policy: AdmissionPolicy) -> None:
+        """Refresh the hysteresis state machine after an outstanding
+        change: saturation latches at the cap and only clears after the
+        replica sits at/below HALF the cap for `hysteresis` seconds --
+        a flapping replica must not oscillate in and out of stream
+        placement."""
+        cap = policy.max_inflight
+        if self.outstanding >= cap:
+            self.saturated = True
+            self.below_since = None
+        elif self.saturated:
+            if self.outstanding <= max(1, cap // 2):
+                if self.below_since is None:
+                    self.below_since = now
+                elif now - self.below_since >= policy.hysteresis_s:
+                    self.saturated = False
+                    self.below_since = None
+            else:
+                self.below_since = None
+
+    def placeable(self, now: float, policy: AdmissionPolicy) -> bool:
+        self.note_load(now, policy)
+        return (not self.dead
+                and not self.saturated
+                and self.fresh(now, policy.stale_after_s))
+
+    def has_capacity(self, policy: AdmissionPolicy) -> bool:
+        return not self.dead and self.outstanding < policy.max_inflight
+
+
+class _GatewayStream:
+    __slots__ = ("stream_id", "priority", "slo_ms", "parameters",
+                 "grace_time", "replica", "queue_response",
+                 "topic_response", "throttle", "inflight", "delivered",
+                 "cursor", "parked", "throttled", "lease")
+
+    def __init__(self, stream_id: str, priority: int, slo_ms: float,
+                 parameters: dict, grace_time: float, replica: _Replica,
+                 queue_response=None, topic_response=None, throttle=None):
+        self.stream_id = stream_id
+        self.priority = priority
+        self.slo_ms = slo_ms
+        self.parameters = parameters
+        self.grace_time = grace_time
+        self.replica = replica
+        self.queue_response = queue_response
+        self.topic_response = topic_response
+        self.throttle = throttle      # local source rate-cap callable
+        # frame_id -> [frame_data, submitted_s, seq]: retained until the
+        # response arrives so replica death can replay from the cursor
+        self.inflight: dict[int, list] = {}
+        self.delivered: set[int] = set()
+        self.cursor = 0
+        self.parked = 0               # this stream's parked-queue entries
+        self.throttled = False
+        self.lease: Lease | None = None
+
+
+class Gateway(Actor):
+    def __init__(self, process, name: str = "gateway", policy=None,
+                 router_seed: int = 0, faults=None, telemetry: bool = True,
+                 metrics_interval: float = 10.0):
+        super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
+        self.policy = AdmissionPolicy.parse(policy)
+        self.replicas: dict[str, _Replica] = {}
+        self.streams: dict[str, _GatewayStream] = {}
+        # parked frames: (priority, seq, stream_id, frame_id), dispatched
+        # min-first (highest priority, oldest), shed max-first.  Bounded
+        # by policy.queue_capacity, so linear scans stay cheap
+        self._parked: list[tuple] = []
+        self._depth_priorities: set[int] = set()
+        self._seq = 0
+        import random
+        self._rng = random.Random(router_seed)
+        self.faults = (create_injector(faults) if isinstance(faults, str)
+                       else (faults if faults is not None
+                             else get_injector()))
+        self.telemetry = GatewayTelemetry(
+            self, enabled=telemetry, interval=metrics_interval)
+        self._completions: list[float] = []
+        self._throttle_on = False
+        self._services_cache = None
+        self._discovery_handler = None
+        self.share.update({
+            "policy": self.policy.spec,
+            "replica_count": 0,
+            "stream_count": 0,
+        })
+
+    def _post_message(self, actor_topic: str, command: str,
+                      parameters) -> None:
+        # replica releases preempt queued client submissions (see
+        # _LocalResponder): without this, an overload backlog in the
+        # `in` mailbox starves every replica of slot-freeing responses
+        if command in ("process_frame_response", "_release_dead_letter",
+                       "_replica_lost"):
+            from ..runtime import ActorTopic
+            actor_topic = ActorTopic.CONTROL
+        super()._post_message(actor_topic, command, parameters)
+
+    # -- replica pool ------------------------------------------------------
+
+    def attach_replica(self, pipeline) -> None:
+        """Wire an in-process Pipeline as a replica (the bench/test fast
+        path: frame data and responses hand off as live objects)."""
+        replica = _Replica(pipeline.topic_path, pipeline.name,
+                          pipeline=pipeline)
+        self._add_replica(replica)
+
+    def discover(self, service_filter: ServiceFilter = None,
+                 **filter_kwargs) -> None:
+        """Watch the registrar (via the process's shared ServicesCache)
+        for pipeline services matching `service_filter`; matches become
+        replicas, removals trigger failover.  Each discovered replica's
+        EC share is mirrored through an ECConsumer -- its `inflight` /
+        `queue_depth` keys are the load gauges routing reads, and the
+        mirror's age gates trust (policy `stale_after`)."""
+        from ..runtime.share import services_cache_create_singleton
+        if service_filter is None:
+            filter_kwargs.setdefault(
+                "protocol", SERVICE_PROTOCOL_PIPELINE)
+            service_filter = ServiceFilter(**filter_kwargs)
+        if self._services_cache is None:
+            self._services_cache = services_cache_create_singleton(
+                self.process)
+
+        def handler(command, fields):
+            if command == "add":
+                self._replica_discovered(fields)
+            elif command == "remove":
+                self.post_message("_replica_lost", [fields.topic_path,
+                                                    "discovery_remove"])
+
+        self._discovery_handler = handler
+        self._services_cache.add_handler(handler, service_filter)
+
+    def _replica_discovered(self, fields) -> None:
+        if fields.topic_path in self.replicas:
+            return
+        from ..runtime.share import ECConsumer
+        cache: dict = {}
+        consumer = ECConsumer(self.process, cache, fields.topic_path)
+        replica = _Replica(fields.topic_path, fields.name,
+                          consumer=consumer, cache=cache)
+        self._add_replica(replica)
+
+    def _add_replica(self, replica: _Replica) -> None:
+        self.replicas[replica.topic_path] = replica
+        # PR 3 reuse: a replica's dead-letter topic is the release path
+        # for frames it dropped/errored -- the gateway frees the slot
+        # instead of waiting out a deadline
+        self.process.add_message_handler(
+            self._dead_letter_handler,
+            f"{replica.topic_path}/dead_letter")
+        self._update_share()
+        _LOGGER.info("%s: replica %s (%s) joined", self.name,
+                     replica.name, replica.topic_path)
+
+    def _replica_lost(self, topic_path, reason) -> None:
+        replica = self.replicas.get(str(topic_path))
+        if replica is not None:
+            self._replica_dead(replica, str(reason))
+
+    def _replica_dead(self, replica: _Replica, reason: str) -> None:
+        """Replica death: fence it (destroy its streams so a zombie
+        stops computing), then migrate every pinned stream to another
+        replica and replay the un-acknowledged frames from the stream
+        cursor.  Frames the zombie already answered are deduped by the
+        per-stream `delivered` set, so clients observe exactly-once.
+
+        Only ever runs as a mailbox continuation (_replica_lost): an
+        injected replica_kill marks the replica dead inline but DEFERS
+        this cleanup, so it never reenters a dispatch or drain loop
+        mid-iteration.  Removal from self.replicas is the
+        exactly-once latch (replica.dead alone is set early by the
+        fault path)."""
+        if self.replicas.pop(replica.topic_path, None) is None:
+            return  # already failed over (e.g. kill then discovery remove)
+        replica.dead = True
+        self.process.remove_message_handler(
+            self._dead_letter_handler,
+            f"{replica.topic_path}/dead_letter")
+        if replica.consumer is not None:
+            replica.consumer.terminate()
+        self.telemetry.replica_deaths.inc()
+        _LOGGER.warning("%s: replica %s died (%s); failing over %d "
+                        "streams", self.name, replica.name, reason,
+                        len(replica.streams))
+        for stream_id in list(replica.streams):
+            self._send_destroy(replica, stream_id)
+        now = time.monotonic()
+        for stream_id in list(replica.streams):
+            replica.streams.discard(stream_id)
+            stream = self.streams.get(stream_id)
+            if stream is None:
+                continue
+            # placement preference order, but failover NEVER fails a
+            # stream while ANY live replica exists: a survivor that is
+            # momentarily saturated (or stale) still gets the stream
+            # pinned -- its frames park and drain as slots free, which
+            # is exactly what the bounded queue is for.  Only an empty
+            # pool hard-fails
+            target = self._place(now) or self._any_replica()
+            if target is None:
+                self._fail_stream(stream, "no_replica_for_failover")
+                continue
+            self.telemetry.failovers.inc()
+            stream.replica = target
+            target.streams.add(stream_id)
+            first = (min(stream.inflight) if stream.inflight
+                     else stream.cursor)
+            self._send_create(target, stream, first_frame_id=first)
+            # replay in frame order; capacity overflow parks (original
+            # seq keeps the parked entries draining in order).  Frames
+            # that were still PARKED at death are already queued -- they
+            # drain to the new replica through the re-pin above
+            parked_ids = {item[3] for item in self._parked
+                          if item[2] == stream_id}
+            for frame_id in sorted(stream.inflight):
+                if frame_id in parked_ids:
+                    continue
+                entry = stream.inflight[frame_id]
+                if (target.has_capacity(self.policy)
+                        and stream.parked == 0):
+                    self._send_frame(target, stream, frame_id, entry)
+                else:
+                    self._park(stream, frame_id, entry[2])
+        self._update_share()
+        # frames that parked while the replica was dying (dispatch saw
+        # replica.dead before this cleanup ran) have no response left to
+        # trigger a drain -- kick it now that streams are re-pinned
+        self._drain_parked()
+
+    # -- placement ---------------------------------------------------------
+
+    def _place(self, now: float) -> _Replica | None:
+        """Power-of-two-choices over the placeable pool: sample two,
+        route to the lower load score.  Deterministic under the
+        `router_seed` RNG."""
+        candidates = [replica for replica in self.replicas.values()
+                      if replica.placeable(now, self.policy)]
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return candidates[0]
+        first, second = self._rng.sample(candidates, 2)
+        return first if first.score() <= second.score() else second
+
+    def _any_replica(self) -> _Replica | None:
+        """Least-loaded LIVE replica ignoring saturation/staleness:
+        the failover fallback (availability beats load hygiene when the
+        alternative is destroying a stream)."""
+        candidates = [replica for replica in self.replicas.values()
+                      if not replica.dead]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda replica: replica.score())
+
+    # -- client surface (pipeline-protocol parity) -------------------------
+
+    def submit_stream(self, stream_id, parameters=None, queue_response=None,
+                      throttle=None,
+                      grace_time: float = DEFAULT_GRACE_TIME) -> None:
+        """Thread-safe local entry: posts through the gateway mailbox
+        (decisions surface on `queue_response` and the counters)."""
+        self.post_message("create_stream", [
+            stream_id, parameters or {}, grace_time, None, queue_response,
+            throttle])
+
+    def submit_frame(self, stream_id, frame_data,
+                     frame_id=None) -> None:
+        stream_dict = {"stream_id": stream_id}
+        if frame_id is not None:
+            stream_dict["frame_id"] = frame_id
+        self.post_message("process_frame", [stream_dict, frame_data])
+
+    def create_stream(self, stream_id, parameters=None,
+                      grace_time=DEFAULT_GRACE_TIME, topic_response=None,
+                      queue_response=None, throttle=None) -> None:
+        stream_id = str(stream_id)
+        try:
+            if isinstance(parameters, str):   # wire call: JSON-encoded
+                parameters = json.loads(parameters) if parameters else {}
+            if isinstance(grace_time, str):
+                grace_time = float(grace_time)
+        except ValueError as error:
+            _LOGGER.warning("%s: bad create_stream arguments: %s",
+                            self.name, error)
+            return
+        parameters = dict(parameters or {})
+        priority = parse_int(parameters.get("priority", 0), 0)
+        slo_ms = parse_float(parameters.get("slo_ms", 0.0), 0.0)
+        if stream_id in self.streams:
+            self._reject_stream(stream_id, "duplicate_stream_id",
+                                topic_response, queue_response)
+            return
+        now = time.monotonic()
+        bucket = self.policy.bucket_for(priority)
+        if bucket is not None and not bucket.try_take(now):
+            self._reject_stream(stream_id, "rate_limited",
+                                topic_response, queue_response)
+            return
+        replica = self._place(now)
+        if replica is None:
+            self._reject_stream(stream_id, "no_replica",
+                                topic_response, queue_response)
+            return
+        if (self.policy.frame_deadline_s > 0
+                and "frame_deadline" not in parameters):
+            # PR 3 machinery: the REPLICA releases wedged frames by
+            # dead-letter, which frees the gateway slot (see
+            # _dead_letter_handler) -- no second deadline layer here
+            parameters["frame_deadline"] = self.policy.frame_deadline_s
+        stream = _GatewayStream(
+            stream_id, priority, slo_ms, parameters, grace_time, replica,
+            queue_response=queue_response, topic_response=topic_response,
+            throttle=throttle)
+        stream.lease = Lease(
+            self.process.event, grace_time, stream_id,
+            lease_expired_handler=self._stream_lease_expired,
+            jitter=self._lease_jitter(stream_id))
+        self.streams[stream_id] = stream
+        replica.streams.add(stream_id)
+        self.telemetry.admitted.inc()
+        self._send_create(replica, stream)
+        if self._throttle_on:
+            # admitted INTO an active overload: this source starts
+            # capped like everyone else, not at full rate
+            stream.throttled = True
+            self.telemetry.throttled.inc()
+            self._send_throttle(stream, self.policy.throttle_rate)
+        self._update_share()
+
+    def _lease_jitter(self, stream_id: str) -> float:
+        from ..runtime.lease import jitter_fraction
+        seed = self.faults.seed if self.faults is not None else 0
+        return jitter_fraction(seed, stream_id, salt="gw-lease")
+
+    def _stream_lease_expired(self, stream_id) -> None:
+        _LOGGER.info("%s: stream %s lease expired", self.name, stream_id)
+        self.destroy_stream(stream_id)
+
+    def _reject_stream(self, stream_id, reason, topic_response,
+                       queue_response) -> None:
+        """Typed shed: the caller learns WHY, immediately -- never
+        silent queue growth (Clockwork-style admission)."""
+        self.telemetry.shed_streams.inc()
+        _LOGGER.info("%s: stream %s shed (%s)", self.name, stream_id,
+                     reason)
+        if topic_response:
+            self.process.publish(
+                topic_response,
+                generate("overloaded", [stream_id, "", reason]))
+        if queue_response is not None:
+            queue_response.put(
+                (stream_id, None, {"reason": reason}, "overloaded"))
+
+    def process_frame(self, stream_dict, frame_data=None) -> None:
+        try:
+            if isinstance(stream_dict, str):
+                stream_dict = json.loads(stream_dict)
+            if isinstance(frame_data, str):
+                frame_data = decode_frame_data(frame_data)
+        except (ValueError, KeyError) as error:
+            _LOGGER.warning("%s: undecodable frame dropped: %s",
+                            self.name, error)
+            return
+        stream_id = str(stream_dict.get("stream_id", ""))
+        stream = self.streams.get(stream_id)
+        if stream is None:
+            _LOGGER.debug("%s: frame for unknown stream %s dropped",
+                          self.name, stream_id)
+            return
+        if stream.lease is not None:
+            stream.lease.extend()
+        frame_id = stream_dict.get("frame_id")
+        frame_id = (stream.cursor if frame_id is None else int(frame_id))
+        if frame_id >= stream.cursor:
+            stream.cursor = frame_id + 1
+        if frame_id in stream.delivered or frame_id in stream.inflight:
+            self.telemetry.duplicates.inc()
+            return
+        # SLO-aware shed: when the estimated queue wait already blows
+        # the stream's declared SLO, rejecting NOW beats serving late
+        if stream.slo_ms > 0 and self._parked:
+            rate = self._completion_rate()
+            if rate is not None:
+                est_wait_ms = len(self._parked) / rate * 1000.0
+                if est_wait_ms > stream.slo_ms:
+                    self._shed_frame(stream, frame_id, "slo")
+                    return
+        seq = self._seq = self._seq + 1
+        entry = [frame_data or {}, time.monotonic(), seq]
+        stream.inflight[frame_id] = entry
+        replica = stream.replica
+        if (replica is not None and replica.has_capacity(self.policy)
+                and stream.parked == 0):
+            self._send_frame(replica, stream, frame_id, entry)
+        else:
+            self._park(stream, frame_id, seq)
+
+    def destroy_stream(self, stream_id) -> None:
+        stream_id = str(stream_id)
+        stream = self.streams.pop(stream_id, None)
+        if stream is None:
+            return
+        if stream.lease is not None:
+            stream.lease.terminate()
+            stream.lease = None
+        parked_ids = {item[3] for item in self._parked
+                      if item[2] == stream_id}
+        if stream.parked:
+            self._parked = [item for item in self._parked
+                            if item[2] != stream_id]
+            stream.parked = 0
+            self._note_queue_depth()
+        replica = stream.replica
+        if replica is not None:
+            replica.streams.discard(stream_id)
+            # only DISPATCHED frames hold replica slots: parked entries
+            # never incremented outstanding
+            replica.outstanding = max(
+                0, replica.outstanding - sum(
+                    1 for frame_id in stream.inflight
+                    if frame_id not in parked_ids))
+            replica.note_load(time.monotonic(), self.policy)
+            self._send_destroy(replica, stream_id)
+        stream.inflight.clear()
+        self._update_share()
+        self._drain_parked()
+
+    # -- replica dispatch --------------------------------------------------
+
+    def _send_create(self, replica: _Replica, stream: _GatewayStream,
+                     first_frame_id: int = 0) -> None:
+        if replica.pipeline is not None:
+            replica.pipeline.post_message("create_stream", [
+                stream.stream_id, dict(stream.parameters),
+                stream.grace_time, self.topic_in,
+                _LocalResponder(self), None, first_frame_id])
+        else:
+            # positional wire call: queue_response/graph_path ride as
+            # None placeholders (the codec renders them as empty lists;
+            # the pipeline coerces falsy back to None) so
+            # first_frame_id -- the failover cursor -- arrives intact
+            self.process.publish(
+                f"{replica.topic_path}/in",
+                generate("create_stream", [
+                    stream.stream_id,
+                    json.dumps(stream.parameters).encode("ascii"),
+                    stream.grace_time, self.topic_in, None, None,
+                    first_frame_id]))
+
+    def _send_destroy(self, replica: _Replica, stream_id: str) -> None:
+        if replica.pipeline is not None:
+            replica.pipeline.post_message("destroy_stream", [stream_id])
+        else:
+            self.process.publish(
+                f"{replica.topic_path}/in",
+                generate("destroy_stream", [stream_id]))
+
+    def _send_frame(self, replica: _Replica, stream: _GatewayStream,
+                    frame_id: int, entry: list) -> None:
+        """Route one frame to `replica`, consulting the seeded
+        `replica_kill` fault point first (one consult per ROUTED frame:
+        frame=k kills the replica on its k-th routed frame)."""
+        if (self.faults is not None and not replica.dead
+                and self.faults.replica_kill(replica.name)):
+            _LOGGER.warning(
+                "%s: injected replica_kill fired on %s (frame %s/%s)",
+                self.name, replica.name, stream.stream_id, frame_id)
+            # fence NOW (no further dispatch picks this replica) but
+            # defer the failover to its own mailbox turn: running it
+            # inline would reenter _drain_parked / the replay loop
+            # mid-iteration (stale snapshot removes, double dispatch).
+            # The un-dispatched frame stays in stream.inflight; the
+            # deferred replay re-routes it with everything else
+            replica.dead = True
+            self.post_message("_replica_lost", [
+                replica.topic_path, "injected replica_kill"])
+            return
+        replica.outstanding += 1
+        replica.routed += 1
+        replica.note_load(time.monotonic(), self.policy)
+        self.telemetry.routed.inc()
+        self.telemetry.record_replica_routed(replica.name)
+        if replica.pipeline is not None:
+            replica.pipeline.post_message("process_frame", [
+                {"stream_id": stream.stream_id, "frame_id": frame_id},
+                entry[0]])
+        else:
+            self.process.publish(
+                f"{replica.topic_path}/in",
+                generate("process_frame", [
+                    {"stream_id": stream.stream_id, "frame_id": frame_id},
+                    encode_frame_data(entry[0]).encode("ascii")]))
+
+    # -- parked queue / backpressure ---------------------------------------
+
+    def _park(self, stream: _GatewayStream, frame_id: int,
+              seq: int) -> None:
+        policy = self.policy
+        if policy.queue_capacity <= 0:
+            self._shed_frame(stream, frame_id, "queue_disabled")
+            return
+        if len(self._parked) >= policy.queue_capacity:
+            # full: the LOWEST-priority (then newest) parked entry goes
+            # first; if the incoming frame IS lowest, shed it directly
+            worst = max(self._parked)
+            incoming = (stream.priority, seq, stream.stream_id, frame_id)
+            if incoming[:2] >= worst[:2]:
+                self._shed_frame(stream, frame_id, "queue_full")
+                return
+            self._parked.remove(worst)
+            victim = self.streams.get(worst[2])
+            if victim is not None:
+                victim.parked = max(0, victim.parked - 1)
+                self._shed_frame(victim, worst[3], "queue_full")
+        self._parked.append(
+            (stream.priority, seq, stream.stream_id, frame_id))
+        stream.parked += 1
+        self._note_queue_depth()
+        self._update_backpressure()
+
+    def _shed_frame(self, stream: _GatewayStream, frame_id: int,
+                    reason: str) -> None:
+        stream.inflight.pop(frame_id, None)
+        self.telemetry.shed_frames.inc()
+        if stream.topic_response:
+            self.process.publish(
+                stream.topic_response,
+                generate("overloaded",
+                         [stream.stream_id, frame_id, reason]))
+        if stream.queue_response is not None:
+            stream.queue_response.put(
+                (stream.stream_id, frame_id, {"reason": reason}, "shed"))
+
+    def _drain_parked(self) -> None:
+        """Dispatch parked frames whose pinned replica has capacity,
+        highest-priority-oldest first.  Per-stream order is preserved:
+        entries carry monotonically increasing seqs and a stream's
+        frames never skip the queue while older siblings wait.
+
+        Always falls through to the watermark check, even when the
+        queue is already empty: destroy_stream/_fail_stream can empty
+        the queue without any dispatch, and a latched throttle-on with
+        capped sources would otherwise never observe the low-water
+        crossing that lifts the caps."""
+        progress = bool(self._parked)
+        while progress and self._parked:
+            progress = False
+            for item in sorted(self._parked):
+                if item not in self._parked:
+                    continue  # removed by an earlier pass over the snapshot
+                priority, seq, stream_id, frame_id = item
+                stream = self.streams.get(stream_id)
+                if stream is None:
+                    self._parked.remove(item)
+                    progress = True
+                    continue
+                entry = stream.inflight.get(frame_id)
+                if entry is None:
+                    self._parked.remove(item)
+                    stream.parked = max(0, stream.parked - 1)
+                    progress = True
+                    continue
+                # only the stream's OLDEST parked frame may dispatch
+                oldest = min(
+                    (other for other in self._parked
+                     if other[2] == stream_id),
+                    default=item)
+                if oldest != item:
+                    continue
+                replica = stream.replica
+                if replica is None or not replica.has_capacity(
+                        self.policy):
+                    continue
+                self._parked.remove(item)
+                stream.parked = max(0, stream.parked - 1)
+                self._send_frame(replica, stream, frame_id, entry)
+                progress = True
+        self._note_queue_depth()
+        self._update_backpressure()
+
+    def _note_queue_depth(self) -> None:
+        self.telemetry.parked.set(len(self._parked))
+        if self.telemetry.enabled:
+            depths: dict[int, int] = {}
+            for priority, _, _, _ in self._parked:
+                depths[priority] = depths.get(priority, 0) + 1
+            # zero-fill priorities reported before: a drained priority
+            # must read 0, not its last nonzero value, in the snapshot
+            for priority in self._depth_priorities - set(depths):
+                depths[priority] = 0
+            self._depth_priorities |= set(depths)
+            self.telemetry.record_queue_depths(depths)
+
+    def _update_backpressure(self) -> None:
+        """Throttle hysteresis over queue occupancy: past the
+        high-water mark every active stream's source is asked to slow
+        to `throttle_rate`; once the queue drains below the low-water
+        mark the cap is lifted (rate 0)."""
+        policy = self.policy
+        capacity = policy.queue_capacity
+        if capacity <= 0:
+            return
+        occupancy = len(self._parked) / capacity
+        if not self._throttle_on and occupancy >= policy.throttle_high:
+            self._throttle_on = True
+            self._signal_throttle(policy.throttle_rate)
+        elif self._throttle_on and occupancy <= policy.throttle_low:
+            self._throttle_on = False
+            self._signal_throttle(0.0)
+
+    def _signal_throttle(self, rate: float) -> None:
+        counter = (self.telemetry.throttled if rate > 0
+                   else self.telemetry.unthrottled)
+        for stream in self.streams.values():
+            throttling = rate > 0
+            if stream.throttled == throttling:
+                continue
+            stream.throttled = throttling
+            counter.inc()
+            self._send_throttle(stream, rate)
+
+    def _send_throttle(self, stream: _GatewayStream, rate: float) -> None:
+        if stream.throttle is not None:
+            try:
+                stream.throttle(stream.stream_id, rate)
+            except Exception:   # a client callback must not kill us
+                _LOGGER.exception("%s: throttle callback failed",
+                                  self.name)
+        # the wire form: sources subscribed to the gateway /out (or
+        # a fronted pipeline's own throttle command) slow down
+        self.publish_out("throttle", [stream.stream_id, rate])
+
+    # -- responses ---------------------------------------------------------
+
+    def process_frame_response(self, stream_dict, frame_data=None) -> None:
+        """A replica answered (success via the local responder or the
+        wire; error/drop via the stream's topic_response notice)."""
+        try:
+            if isinstance(stream_dict, str):
+                stream_dict = json.loads(stream_dict)
+        except ValueError as error:
+            _LOGGER.warning("%s: undecodable frame response dropped: %s",
+                            self.name, error)
+            return
+        stream_id = str(stream_dict.get("stream_id", ""))
+        stream = self.streams.get(stream_id)
+        if stream is None:
+            return
+        frame_id = int(stream_dict.get("frame_id", 0))
+        event = stream_dict.get("event")
+        if isinstance(frame_data, str):
+            try:
+                frame_data = decode_frame_data(frame_data)
+            except (ValueError, KeyError):
+                event = event or "error"
+                frame_data = {}
+        self._frame_done(stream, frame_id, frame_data or {}, event)
+
+    def _dead_letter_handler(self, topic: str, payload: str) -> None:
+        """A replica dead-lettered a frame (PR 3): release the slot as
+        an error.  Runs on the process message pump; route through the
+        mailbox to keep actor ordering."""
+        try:
+            command, parameters = parse(payload)
+        except ValueError:
+            return
+        if command != "dead_letter" or not parameters:
+            return
+        meta = parameters[0] if isinstance(parameters[0], dict) else {}
+        from ..runtime import ActorTopic
+        # a dead-letter frees a replica slot: preempt queued submissions
+        self.post_message("_release_dead_letter", [
+            meta.get("stream_id", ""), meta.get("frame_id", -1),
+            meta.get("reason", "dead_letter")],
+            actor_topic=ActorTopic.CONTROL)
+
+    def _release_dead_letter(self, stream_id, frame_id, reason) -> None:
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            return
+        try:
+            frame_id = int(frame_id)
+        except (TypeError, ValueError):
+            return
+        self._frame_done(stream, frame_id, {"reason": str(reason)},
+                         event="error")
+
+    def _frame_done(self, stream: _GatewayStream, frame_id: int,
+                    outputs: dict, event=None) -> None:
+        entry = stream.inflight.pop(frame_id, None)
+        if entry is None or frame_id in stream.delivered:
+            self.telemetry.duplicates.inc()
+            return
+        stream.delivered.add(frame_id)
+        if len(stream.delivered) > 8192:
+            # bounded: long-lived streams must not grow the dedupe set
+            # forever; ids far below the cursor can no longer recur
+            floor = stream.cursor - 4096
+            stream.delivered = {fid for fid in stream.delivered
+                                if fid >= floor}
+        replica = stream.replica
+        if replica is not None:
+            replica.outstanding = max(0, replica.outstanding - 1)
+            replica.note_load(time.monotonic(), self.policy)
+        now = time.monotonic()
+        if event:
+            self.telemetry.released.inc()
+            status = "error" if event == "error" else "dropped"
+        else:
+            self.telemetry.completed.inc()
+            self.telemetry.latency.record(now - entry[1])
+            self._completions.append(now)
+            if len(self._completions) > _RATE_WINDOW:
+                del self._completions[:len(self._completions)
+                                      - _RATE_WINDOW]
+            status = "ok"
+        if stream.queue_response is not None:
+            stream.queue_response.put(
+                (stream.stream_id, frame_id, outputs, status))
+        elif stream.topic_response:
+            reply = {"stream_id": stream.stream_id, "frame_id": frame_id}
+            if event:
+                reply["event"] = event
+                self.process.publish(
+                    stream.topic_response,
+                    generate("process_frame_response", [reply]))
+            else:
+                self.process.publish(
+                    stream.topic_response,
+                    generate("process_frame_response", [
+                        reply,
+                        encode_frame_data(outputs).encode("ascii")]))
+        self._drain_parked()
+
+    def _completion_rate(self) -> float | None:
+        """Completions/sec over the recent window (None until warm):
+        the denominator of the SLO queue-wait estimate."""
+        if len(self._completions) < _RATE_WARMUP:
+            return None
+        window = self._completions[-1] - self._completions[0]
+        if window <= 0:
+            return None
+        return (len(self._completions) - 1) / window
+
+    def _fail_stream(self, stream: _GatewayStream, reason: str) -> None:
+        _LOGGER.error("%s: stream %s failed (%s); releasing %d in-flight"
+                      " frames", self.name, stream.stream_id, reason,
+                      len(stream.inflight))
+        for frame_id in sorted(stream.inflight):
+            self.telemetry.released.inc()
+            if stream.queue_response is not None:
+                stream.queue_response.put(
+                    (stream.stream_id, frame_id, {"reason": reason},
+                     "error"))
+            elif stream.topic_response:
+                self.process.publish(
+                    stream.topic_response,
+                    generate("process_frame_response", [
+                        {"stream_id": stream.stream_id,
+                         "frame_id": frame_id, "event": "error"}]))
+        stream.inflight.clear()
+        if stream.parked:
+            self._parked = [item for item in self._parked
+                            if item[2] != stream.stream_id]
+            stream.parked = 0
+            self._note_queue_depth()
+        if stream.lease is not None:
+            stream.lease.terminate()
+            stream.lease = None
+        self.streams.pop(stream.stream_id, None)
+        self._update_share()
+
+    # -- observability -----------------------------------------------------
+
+    def _update_share(self) -> None:
+        self.telemetry.replicas.set(len(self.replicas))
+        if self.ec_producer is not None:
+            self.ec_producer.update("replica_count", len(self.replicas))
+            self.ec_producer.update("stream_count", len(self.streams))
+
+    def stop(self) -> None:
+        self.telemetry.stop()
+        for stream_id in list(self.streams):
+            self.destroy_stream(stream_id)
+        for replica in list(self.replicas.values()):
+            self.process.remove_message_handler(
+                self._dead_letter_handler,
+                f"{replica.topic_path}/dead_letter")
+            if replica.consumer is not None:
+                replica.consumer.terminate()
+        self.replicas.clear()
+        if (self._services_cache is not None
+                and self._discovery_handler is not None):
+            self._services_cache.remove_handler(self._discovery_handler)
+            self._discovery_handler = None
+        super().stop()

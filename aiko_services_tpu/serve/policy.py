@@ -1,0 +1,159 @@
+# Admission policy for the serving gateway: WHO gets in, HOW MUCH each
+# replica carries, and WHEN the tier sheds instead of queueing.
+#
+# Grammar (gateway parameter `policy`, same directive style as the
+# fault-harness spec so operators learn one shape):
+#
+#   policy    := directive (";" directive)*
+#   directive := "max_inflight=" int     frames in flight per replica
+#              | "queue=" int            bounded parked-frame queue length
+#              | "hysteresis=" float     seconds a saturated replica must
+#                                        stay below half cap to rejoin
+#                                        stream placement
+#              | "stale_after=" float    seconds without an EC share
+#                                        update before a discovered
+#                                        replica's load view is distrusted
+#              | "throttle_high=" float  queue fraction that triggers
+#                                        `(throttle ...)` to sources
+#              | "throttle_low=" float   queue fraction that lifts it
+#              | "throttle_rate=" float  frames/sec cap sent to throttled
+#                                        sources
+#              | "frame_deadline=" float seconds, injected into replica
+#                                        streams (PR 3 machinery: a
+#                                        wedged replica releases frames
+#                                        by dead-letter instead of
+#                                        leaking gateway slots)
+#              | "bucket:" prio "=" rate "/" burst
+#                                        per-priority token bucket for
+#                                        STREAM admission (priority 0 is
+#                                        most important; priorities
+#                                        without a bucket admit freely)
+#
+# Example: "max_inflight=8;queue=64;hysteresis=0.5;bucket:2=10/4"
+#
+# Validation is at parse time, like the pipeline-definition and fault
+# grammars: a typo'd policy must fail the gateway's construction, not
+# silently admit everything.
+
+from __future__ import annotations
+
+__all__ = ["AdmissionPolicy", "TokenBucket"]
+
+DEFAULT_MAX_INFLIGHT = 8
+DEFAULT_QUEUE_CAPACITY = 64
+DEFAULT_HYSTERESIS_S = 0.5
+DEFAULT_STALE_AFTER_S = 15.0
+DEFAULT_THROTTLE_HIGH = 0.5
+DEFAULT_THROTTLE_LOW = 0.125
+DEFAULT_THROTTLE_RATE = 5.0
+
+
+class TokenBucket:
+    """Classic token bucket with caller-supplied time: `now` is always
+    passed in (monotonic seconds) so tests drive it deterministically
+    and the gateway pays no clock read when no bucket is configured."""
+
+    __slots__ = ("rate", "burst", "tokens", "updated")
+
+    def __init__(self, rate: float, burst: float):
+        if rate <= 0 or burst <= 0:
+            raise ValueError(
+                f"token bucket needs rate > 0 and burst > 0, got "
+                f"{rate}/{burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.updated: float | None = None
+
+    def try_take(self, now: float) -> bool:
+        if self.updated is not None:
+            self.tokens = min(
+                self.burst, self.tokens + (now - self.updated) * self.rate)
+        self.updated = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class AdmissionPolicy:
+    __slots__ = ("max_inflight", "queue_capacity", "hysteresis_s",
+                 "stale_after_s", "throttle_high", "throttle_low",
+                 "throttle_rate", "frame_deadline_s", "buckets", "spec")
+
+    def __init__(self):
+        self.max_inflight = DEFAULT_MAX_INFLIGHT
+        self.queue_capacity = DEFAULT_QUEUE_CAPACITY
+        self.hysteresis_s = DEFAULT_HYSTERESIS_S
+        self.stale_after_s = DEFAULT_STALE_AFTER_S
+        self.throttle_high = DEFAULT_THROTTLE_HIGH
+        self.throttle_low = DEFAULT_THROTTLE_LOW
+        self.throttle_rate = DEFAULT_THROTTLE_RATE
+        self.frame_deadline_s = 0.0
+        self.buckets: dict[int, TokenBucket] = {}
+        self.spec = ""
+
+    @classmethod
+    def parse(cls, spec) -> "AdmissionPolicy":
+        """Parse a policy spec (str in the grammar above, a dict of the
+        same keys, or None for all defaults)."""
+        policy = cls()
+        if spec is None or spec == "":
+            return policy
+        if isinstance(spec, AdmissionPolicy):
+            return spec
+        if isinstance(spec, dict):
+            items = list(spec.items())
+        else:
+            items = []
+            for part in str(spec).split(";"):
+                part = part.strip()
+                if not part:
+                    continue
+                key, sep, value = part.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"policy directive {part!r} is not key=value")
+                items.append((key.strip(), value.strip()))
+            policy.spec = str(spec)
+        for key, value in items:
+            if key.startswith("bucket:"):
+                priority = int(key.split(":", 1)[1])
+                if isinstance(value, (tuple, list)):
+                    rate, burst = value
+                else:
+                    rate, _, burst = str(value).partition("/")
+                policy.buckets[priority] = TokenBucket(
+                    float(rate), float(burst or rate))
+            elif key == "max_inflight":
+                policy.max_inflight = max(1, int(value))
+            elif key == "queue":
+                policy.queue_capacity = max(0, int(value))
+            elif key == "hysteresis":
+                policy.hysteresis_s = max(0.0, float(value))
+            elif key == "stale_after":
+                policy.stale_after_s = max(0.0, float(value))
+            elif key == "throttle_high":
+                policy.throttle_high = float(value)
+            elif key == "throttle_low":
+                policy.throttle_low = float(value)
+            elif key == "throttle_rate":
+                policy.throttle_rate = float(value)
+            elif key == "frame_deadline":
+                policy.frame_deadline_s = max(0.0, float(value))
+            else:
+                raise ValueError(f"unknown policy directive: {key!r}")
+        if policy.throttle_low > policy.throttle_high:
+            raise ValueError(
+                f"throttle_low {policy.throttle_low} must not exceed "
+                f"throttle_high {policy.throttle_high}")
+        return policy
+
+    def bucket_for(self, priority: int) -> TokenBucket | None:
+        return self.buckets.get(int(priority))
+
+    def __repr__(self):
+        return (f"AdmissionPolicy(max_inflight={self.max_inflight}, "
+                f"queue={self.queue_capacity}, "
+                f"hysteresis={self.hysteresis_s}, "
+                f"buckets={sorted(self.buckets)})")

@@ -169,6 +169,15 @@ class LoopbackTransport(Transport):
             self._broker.detach(self, send_lwt)
         self._connected = False
 
+    def sever(self) -> None:
+        """Abnormal death: drop off the broker WITHOUT a clean
+        disconnect, firing every registered last-will (exactly what a
+        real broker does when a client's TCP session dies).  Tests use
+        this to crash a replica process mid-stream -- the registrar
+        reaps it from the LWT "(absent)" notice and discovery-driven
+        consumers (ServicesCache, the serving gateway) must converge."""
+        self.disconnect(send_lwt=True)
+
     def publish(self, topic: str, payload, retain: bool = False) -> None:
         if self._broker is None:
             raise RuntimeError("LoopbackTransport not connected")

@@ -1036,6 +1036,194 @@ def bench_serving(peak):
     }
 
 
+# -- router: the serving config behind the gateway ---------------------------
+
+def bench_router(peak, replicas_n: int):
+    """`--router N`: the serving workload fronted by the Gateway with N
+    in-process replicas under OPEN-LOOP overload -- frames offered at
+    2x the measured aggregate capacity regardless of completions, the
+    regime where an unprotected pipeline grows its queue without bound.
+    Published numbers: goodput (admitted completions/sec), shed rate,
+    and p50/p99 admitted latency (submit -> completion through the
+    gateway, each response device-synced before timestamping, so the
+    latency is conservative)."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aiko_services_tpu.models import detector_flops_per_image
+    from aiko_services_tpu.models.configs import DETECTOR_TOY, YOLOV8N_SHAPE
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process
+    from aiko_services_tpu.serve import Gateway
+
+    config = DETECTOR_TOY if SMOKE else YOLOV8N_SHAPE
+    preset = "toy" if SMOKE else "yolov8n"
+    size = config.image_size
+    micro = 4 if SMOKE else 16
+    streams_n = 4 if SMOKE else 16
+    per_stream = 4 if SMOKE else 30
+    images = [
+        jax.random.uniform(jax.random.PRNGKey(index), (1, 3, size, size),
+                           jnp.float32)
+        for index in range(4)]
+
+    def definition(name):
+        return {
+            "name": name,
+            "parameters": {"telemetry": TELEMETRY,
+                           "metrics_interval": 60.0},
+            "graph": ["(detector)"],
+            "elements": [
+                {"name": "detector", "input": [{"name": "image"}],
+                 "output": [{"name": "detections"}],
+                 "parameters": {"preset": preset, "micro_batch": micro,
+                                "dtype": ("float32" if SMOKE
+                                          else "bfloat16")},
+                 "deploy": _local("Detector")},
+            ],
+        }
+
+    # phase 1: ONE replica driven closed-loop to saturation -- the
+    # capacity the overload is calibrated against
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition("capacity_probe"))
+    responses = queue.Queue()
+    warm = pipeline.create_stream("warm", queue_response=responses,
+                                  grace_time=1800)
+    for index in range(max(micro, 2)):
+        pipeline.create_frame(warm, {"image": images[index % 4]})
+    process.run(in_thread=True)
+    _barrier([responses.get(timeout=900)[2].get("detections")
+              for _ in range(max(micro, 2))])
+    streams = [pipeline.create_stream(f"s{index}",
+                                      queue_response=responses,
+                                      grace_time=1800)
+               for index in range(streams_n)]
+    total = streams_n * per_stream
+    start = time.perf_counter()
+    for round_index in range(per_stream):
+        for stream in streams:
+            pipeline.create_frame(stream,
+                                  {"image": images[round_index % 4]})
+    refs = [responses.get(timeout=900)[2].get("detections")
+            for _ in range(total)]
+    capacity = total / _honest_elapsed(start, refs)
+    process.terminate()
+
+    # phase 2: N replicas behind the gateway, offered 2x aggregate
+    # capacity open-loop
+    processes, replicas = [], []
+    for index in range(replicas_n):
+        replica_process = Process(transport_kind="loopback")
+        processes.append(replica_process)
+        replicas.append(create_pipeline(
+            replica_process, definition(f"replica{index}")))
+    gateway_process = Process(transport_kind="loopback")
+    processes.append(gateway_process)
+    policy = (f"max_inflight={4 * micro};"
+              f"queue={4 * micro * max(replicas_n, 1)}")
+    gateway = Gateway(gateway_process, policy=policy, router_seed=7,
+                      telemetry=True, metrics_interval=60.0)
+    for replica in replicas:
+        gateway.attach_replica(replica)
+    for proc in processes:
+        proc.run(in_thread=True)
+
+    gateway_responses = queue.Queue()
+    for index in range(streams_n):
+        gateway.submit_stream(f"g{index}",
+                              queue_response=gateway_responses)
+    # warm every replica's compiled shapes before the measured window
+    for index in range(streams_n):
+        gateway.submit_frame(f"g{index}", {"image": images[index % 4]})
+    warm_refs = []
+    for _ in range(streams_n):
+        _, _, outputs, status = gateway_responses.get(timeout=900)
+        if status == "ok":
+            warm_refs.append(outputs.get("detections"))
+    _barrier(warm_refs)
+
+    offered_rate = 2.0 * capacity * replicas_n
+    window_s = 1.0 if SMOKE else 3.0
+    offered = max(int(offered_rate * window_s), streams_n)
+    submit_times = {}
+    latencies, ok_refs = [], []
+    counts = {"ok": 0, "shed": 0, "error": 0}
+    done = threading.Event()
+
+    def drain():
+        for _ in range(offered):
+            stream_id, frame_id, outputs, status = gateway_responses.get(
+                timeout=900)
+            if status == "ok":
+                _sync(outputs.get("detections"))
+                end = time.perf_counter()
+                submitted = submit_times.pop((stream_id, frame_id), None)
+                if submitted is not None:
+                    latencies.append(end - submitted)
+                ok_refs.append(outputs.get("detections"))
+                counts["ok"] += 1
+            else:
+                counts[status if status in counts else "error"] += 1
+                submit_times.pop((stream_id, frame_id), None)
+        done.set()
+
+    drainer = threading.Thread(target=drain, daemon=True)
+    drainer.start()
+    interval = 1.0 / offered_rate
+    start = time.perf_counter()
+    # frame ids start AFTER the warm frame (id 0): a reused id would be
+    # deduped by the gateway's exactly-once delivery, not re-served
+    cursors = {f"g{index}": 1 for index in range(streams_n)}
+    for index in range(offered):
+        stream_id = f"g{index % streams_n}"
+        frame_id = cursors[stream_id]
+        cursors[stream_id] += 1
+        submit_times[(stream_id, frame_id)] = time.perf_counter()
+        gateway.submit_frame(stream_id, {"image": images[index % 4]},
+                             frame_id=frame_id)
+        ahead = start + (index + 1) * interval - time.perf_counter()
+        if ahead > 0:
+            time.sleep(ahead)
+    done.wait(timeout=900)
+    elapsed = _honest_elapsed(start, ok_refs)
+    goodput = counts["ok"] / elapsed
+    shed_rate = counts["shed"] / max(offered, 1)
+    summary = gateway.telemetry.summary()
+    for proc in processes:
+        proc.terminate()
+    flops = detector_flops_per_image(config)
+    return {
+        "replicas": replicas_n,
+        "streams": streams_n,
+        # in-process replicas share the host CPU with the gateway's
+        # event loop, so goodput_vs_aggregate_capacity includes that
+        # contention -- deployed replicas (own hosts) only pay the
+        # gateway's per-frame routing cost
+        "topology": "in-process replicas, shared host",
+        "policy": policy,
+        "model": f"{preset} {size}x{size}",
+        "micro_batch": micro,
+        "capacity_single_fps": round(capacity, 1),
+        "offered_fps": round(offered_rate, 1),
+        "offered_frames": offered,
+        "goodput_fps": round(goodput, 1),
+        "goodput_vs_aggregate_capacity": round(
+            goodput / max(capacity * replicas_n, 1e-9), 3),
+        "shed_rate": round(shed_rate, 3),
+        "errors": counts["error"],
+        "p50_admitted_ms": (round(float(np.percentile(
+            latencies, 50)) * 1000, 2) if latencies else None),
+        "p99_admitted_ms": (round(float(np.percentile(
+            latencies, 99)) * 1000, 2) if latencies else None),
+        "gateway": summary,
+        "mfu": _mfu(goodput * flops, peak),
+    }
+
+
 # -- config 7: TTS -----------------------------------------------------------
 
 def bench_tts(peak):
@@ -1164,20 +1352,27 @@ def _accelerator_failure(timeout: float = 120.0) -> str | None:
 def main() -> None:
     global SMOKE, _TRACE_PATH, _FAULTS_SEED
     argv = sys.argv[1:]
+    usage = ("usage: bench.py [--trace <path>] [--faults <seed>] "
+             "[--router <replicas>]")
     if "--trace" in argv:
         index = argv.index("--trace")
         if index + 1 >= len(argv):
-            print("usage: bench.py [--trace <path>] [--faults <seed>]",
-                  file=sys.stderr)
+            print(usage, file=sys.stderr)
             os._exit(2)
         _TRACE_PATH = argv[index + 1]
     if "--faults" in argv:
         index = argv.index("--faults")
         if index + 1 >= len(argv):
-            print("usage: bench.py [--trace <path>] [--faults <seed>]",
-                  file=sys.stderr)
+            print(usage, file=sys.stderr)
             os._exit(2)
         _FAULTS_SEED = int(argv[index + 1])
+    router_replicas = None
+    if "--router" in argv:
+        index = argv.index("--router")
+        if index + 1 >= len(argv):
+            print(usage, file=sys.stderr)
+            os._exit(2)
+        router_replicas = max(1, int(argv[index + 1]))
     platform = os.environ.get("AIKO_BENCH_PLATFORM")
     device_fallback = None
     if platform:
@@ -1216,6 +1411,8 @@ def main() -> None:
         configs["longcontext"] = bench_longcontext(peak)
     if "serving" in wanted:
         configs["serving"] = bench_serving(peak)
+    if router_replicas is not None or "router" in wanted:
+        configs["router"] = bench_router(peak, router_replicas or 2)
     if "latency" in wanted:
         configs["latency"] = bench_latency(peak)
     if "tts" in wanted:
