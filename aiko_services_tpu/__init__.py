@@ -14,6 +14,8 @@
 #   transport/ L1 message transports (loopback broker, MQTT, null)
 #   runtime/   L2-L8 event engine, process, service, actor, share, registrar
 #   observe/   telemetry: metrics registry, frame tracer, live export
+#   analyze/   definition-time static analysis: typed tensor ports,
+#              shape-flow verification, actor-safety lint (aiko lint)
 #   pipeline/  L9 pipeline engine: streams, frames, elements, graphs
 #   serve/     L10 serving tier: gateway (admission, routing, failover)
 #   ops/       TPU ops: attention, mel spectrogram, image, pallas kernels

@@ -1,0 +1,90 @@
+# Pass 4 -- policy-grammar verification (AIKO4xx).
+#
+# Every operator-facing mini-grammar the engine grew -- the
+# fault-tolerance parameters (`on_error`, `max_retries`, ...), the
+# fault-injection spec (faults.py), and the gateway admission policy
+# (serve/policy.py) -- now parses through ONE shared core
+# (analyze/grammar.py), so this pass can verify any of them OFFLINE
+# with the same error quality construction would produce: a typo'd
+# policy is a lint finding in CI, not a wedged stream at 2 a.m.
+
+from __future__ import annotations
+
+from .diagnostics import AnalysisReport, Diagnostic
+from .grammar import Field
+
+__all__ = ["run_policy_pass", "check_gateway_policy",
+           "check_faults_spec", "FAULT_TOLERANCE_FIELDS"]
+
+# The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
+# stream scoped).  `on_error` choices are filled in lazily from the
+# engine's ERROR_POLICIES so the two can never drift.
+FAULT_TOLERANCE_FIELDS = {
+    "max_retries": Field("int", minimum=0),
+    "retry_backoff_ms": Field("float", minimum=0.0),
+    "error_budget": Field("int", minimum=0),
+    "error_window": Field("float", minimum=0.0),
+    "frame_deadline": Field("float", minimum=0.0),
+    "park_timeout": Field("float", minimum=0.0),
+}
+
+
+def _on_error_field():
+    from ..pipeline.element import ERROR_POLICIES
+    return Field("str", choices=ERROR_POLICIES)
+
+
+def check_faults_spec(spec) -> list:
+    """(code, message) problems in a fault-injection spec."""
+    from ..faults import FAULTS_GRAMMAR
+    return FAULTS_GRAMMAR.check(spec, value_code="AIKO402")
+
+
+def check_gateway_policy(spec) -> list:
+    """(code, message) problems in a gateway admission-policy spec.
+
+    After the per-directive grammar check, a grammar-clean spec goes
+    through the REAL AdmissionPolicy.parse so cross-field constraints
+    (throttle_low <= throttle_high, bucket rate/burst > 0) fail
+    offline exactly as they would at Gateway construction."""
+    from ..serve.policy import POLICY_GRAMMAR, AdmissionPolicy
+    problems = POLICY_GRAMMAR.check(spec, value_code="AIKO403")
+    if not problems:
+        try:
+            AdmissionPolicy.parse(spec)
+        except ValueError as error:
+            problems.append(("AIKO403", str(error)))
+    return problems
+
+
+def run_policy_pass(definition) -> AnalysisReport:
+    report = AnalysisReport(passes_run=["policy"])
+    name = definition.name
+    on_error = _on_error_field()
+    scopes = ([("", definition.parameters)]
+              + [(element.name, element.parameters)
+                 for element in definition.elements])
+    for element_name, parameters in scopes:
+        parameters = parameters or {}
+        fields = dict(FAULT_TOLERANCE_FIELDS)
+        fields["on_error"] = on_error
+        for key, field in fields.items():
+            if key not in parameters:
+                continue
+            try:
+                field.coerce("fault-tolerance", key, parameters[key])
+            except ValueError as error:
+                report.add(Diagnostic(
+                    "AIKO401", str(error), definition=name,
+                    element=element_name))
+    faults_spec = (definition.parameters or {}).get("faults")
+    if faults_spec:
+        for code, message in check_faults_spec(faults_spec):
+            report.add(Diagnostic(code, message, definition=name))
+    # gateways are services, not graph nodes, but operators embed their
+    # policy next to the definition often enough to be worth checking
+    policy_spec = (definition.parameters or {}).get("gateway_policy")
+    if policy_spec:
+        for code, message in check_gateway_policy(policy_spec):
+            report.add(Diagnostic(code, message, definition=name))
+    return report

@@ -1,0 +1,191 @@
+# Pass 2 -- abstract interpretation of element compute under
+# jax.eval_shape (AIKO207/AIKO208).
+#
+# For local elements exposing a pure device program (the
+# PipelineElement.eval_kernel contract), the pass synthesizes
+# jax.ShapeDtypeStructs from the DECLARED input specs and dry-runs
+# state-build + kernel under jax.eval_shape: the declared output specs
+# are PROVEN against the traced outputs without allocating a parameter,
+# compiling a program, or touching a device -- the same trick
+# jax.eval_shape plays for cost estimation, pointed at the pipeline
+# definition layer.
+#
+# Elements with no pure program (sources, async host elements, custom
+# host-side process_frame without an eval_kernel override) are skipped;
+# elements whose trace fails report AIKO208 (info -- an analysis limit,
+# not a defect).
+
+from __future__ import annotations
+
+from .diagnostics import AnalysisReport, Diagnostic
+from .specs import resolve_dims
+
+__all__ = ["run_eval_pass"]
+
+
+def _synthesize(spec, bindings: dict, default_size: int):
+    """Concrete shape for one input spec, or None when it cannot be
+    synthesized faithfully.  The LEADING axis may default (it is the
+    batch contract -- any size traces the same program); an UNBOUND
+    symbol or wildcard on an inner axis means the definition does not
+    pin the sizes the kernel's architecture depends on, so the element
+    is skipped rather than traced at a made-up size."""
+    if not spec.is_tensor:
+        return None
+    shape = []
+    for axis, dim in enumerate(spec.dims):
+        if isinstance(dim, int):
+            shape.append(dim)
+            continue
+        bound = bindings.get(dim) if dim != "*" else None
+        if bound is not None:
+            shape.append(bound[0])
+            continue
+        if axis > 0:
+            return None
+        if dim != "*":
+            bindings[dim] = (default_size, "synthesized")
+        shape.append(default_size)
+    return tuple(shape)
+
+
+def _compare(report, definition_name, element_name, port_name,
+             declared, traced, bindings) -> None:
+    """AIKO207 when a traced leaf disagrees with its declared spec."""
+    expected_shape = resolve_dims(declared, bindings)
+    traced_shape = tuple(getattr(traced, "shape", ()))
+    traced_dtype = str(getattr(traced, "dtype", ""))
+    problems = []
+    if declared.dtype is not None and traced_dtype != declared.dtype:
+        problems.append(f"dtype {traced_dtype} != declared "
+                        f"{declared.dtype}")
+    if expected_shape is not None:
+        if len(traced_shape) != len(declared.dims):
+            problems.append(
+                f"rank {len(traced_shape)} != declared rank "
+                f"{len(declared.dims)}")
+        else:
+            for axis, (dim, traced_size) in enumerate(
+                    zip(declared.dims, traced_shape)):
+                if dim == "*":
+                    continue
+                if isinstance(dim, int):
+                    if traced_size != dim:
+                        problems.append(
+                            f"axis {axis}: traced {traced_size} != "
+                            f"declared {dim}")
+                else:
+                    bound = bindings.get(dim)
+                    if bound is None:
+                        bindings[dim] = (traced_size, "traced output")
+                    elif bound[0] != traced_size:
+                        problems.append(
+                            f"axis {axis}: traced {traced_size} != "
+                            f"symbol {dim!r} bound to {bound[0]}")
+    if problems:
+        report.add(Diagnostic(
+            "AIKO207",
+            f"declared {declared.raw!r} but jax.eval_shape traced "
+            f"{traced_dtype}{list(traced_shape)}: "
+            + "; ".join(problems),
+            definition=definition_name, element=element_name,
+            port=str(port_name)))
+
+
+def _trace_element(report, definition, element_def, element, input_specs,
+                   output_specs, bindings, default_size) -> None:
+    import jax
+
+    kernel_spec = element.eval_kernel()
+    if kernel_spec is None:
+        return
+    kernel, state_fn = kernel_spec
+    structs = {}
+    for port_name, spec in input_specs.items():
+        shape = _synthesize(spec, bindings, default_size)
+        if shape is None:
+            # opaque input (str prompts, "any") or un-pinned inner
+            # sizes: the kernel cannot be driven faithfully from the
+            # declared specs -- skipped, not a finding (declare
+            # concrete tensor specs to opt the element in)
+            return
+        structs[port_name] = jax.ShapeDtypeStruct(
+            shape, jax.numpy.dtype(spec.dtype))
+    state_struct = (jax.eval_shape(state_fn)
+                    if state_fn is not None else None)
+    traced = jax.eval_shape(kernel, state_struct, **structs)
+    if not isinstance(traced, dict):
+        report.add(Diagnostic(
+            "AIKO208",
+            f"eval kernel returned {type(traced).__name__}, not a "
+            f"dict of outputs", definition=definition.name,
+            element=element_def.name))
+        return
+    for port_name, declared in output_specs.items():
+        if not declared.is_tensor:
+            continue  # opaque declared types prove nothing
+        leaf = traced.get(port_name)
+        if leaf is None or not hasattr(leaf, "shape"):
+            # host-produced output (text decode, overlay dicts): the
+            # kernel covers the device subset only
+            continue
+        _compare(report, definition.name, element_def.name, port_name,
+                 declared, leaf, bindings)
+    report.traced_elements.append(element_def.name)
+
+
+def run_eval_pass(definition, input_specs, output_specs,
+                  symbol_bindings=None,
+                  default_symbol_size: int = 2) -> AnalysisReport:
+    """Dry-run every local element's pure device program under
+    jax.eval_shape against the declared port specs.
+
+    `input_specs`/`output_specs` are the per-element {port: PortSpec}
+    maps the graph pass resolved; `symbol_bindings` its symbol table
+    (shared so the whole graph traces under ONE binding)."""
+    from ..pipeline.element import PipelineElement
+    from ..runtime import Process
+    from ..utils import load_module
+
+    report = AnalysisReport(passes_run=["eval"])
+    report.traced_elements = []
+    bindings = dict(symbol_bindings or {})
+    process = Process(transport_kind="null")
+    try:
+        for element_def in definition.elements:
+            if not element_def.is_local:
+                continue
+            try:
+                module = load_module(element_def.deploy_local["module"])
+                cls = getattr(module,
+                              element_def.deploy_local["class_name"])
+                if not (isinstance(cls, type)
+                        and issubclass(cls, PipelineElement)):
+                    continue  # AIKO304 is the actor pass's finding
+                element = cls(process, None, element_def)
+            except Exception as error:
+                report.add(Diagnostic(
+                    "AIKO208",
+                    f"cannot instantiate for shape tracing: {error}",
+                    definition=definition.name,
+                    element=element_def.name))
+                continue
+            try:
+                _trace_element(
+                    report, definition, element_def, element,
+                    input_specs.get(element_def.name, {}),
+                    output_specs.get(element_def.name, {}),
+                    bindings, default_symbol_size)
+            except Exception as error:
+                report.add(Diagnostic(
+                    "AIKO208",
+                    f"shape trace failed: {type(error).__name__}: "
+                    f"{error}", definition=definition.name,
+                    element=element_def.name))
+    finally:
+        try:
+            process.terminate()
+        except Exception:
+            pass
+    report.symbol_bindings = bindings
+    return report

@@ -96,6 +96,119 @@ def dashboard(transport: str | None, snapshot: bool, wait: float) -> None:
 
 
 @main.command()
+@click.argument("sources", nargs=-1, type=click.Path())
+@click.option("--strict", is_flag=True,
+              help="Fail on warnings too (errors always fail)")
+@click.option("--format", "fmt",
+              type=click.Choice(["text", "json"]), default="text")
+@click.option("--output", default=None, type=click.Path(),
+              help="Also write the report to this file")
+@click.option("--passes", "passes_option", default=None,
+              help="Comma-separated pass list "
+                   "(graph,policy,actor,eval); default: all")
+@click.option("--bench", "bench_configs", is_flag=True,
+              help="Also lint every pipeline definition bench.py "
+                   "constructs")
+@click.option("--golden", default=None,
+              type=click.Path(exists=True, file_okay=False),
+              help="Verify a corpus of deliberately-broken definitions:"
+                   " each <code>_*.json must produce that rule code")
+def lint(sources, strict, fmt, output, passes_option, bench_configs,
+         golden) -> None:
+    """Statically verify pipeline definitions (analyze/ subsystem).
+
+    SOURCES are definition JSON files or directories (searched
+    recursively for *.json).  Four passes: graph/port dataflow
+    (AIKO1xx), tensor-spec shape/dtype flow (AIKO2xx, including a
+    jax.eval_shape dry-run of element device programs), element/actor
+    safety (AIKO3xx), and policy grammars (AIKO4xx).  Exit status: 0
+    clean, 1 findings (with --strict, warnings count), 2 usage error.
+    """
+    import sys
+    from pathlib import Path
+
+    from .analyze import ALL_PASSES, AnalysisReport, analyze_definition
+
+    passes = (tuple(part.strip() for part in passes_option.split(",")
+                    if part.strip())
+              if passes_option else ALL_PASSES)
+    unknown = [name for name in passes if name not in ALL_PASSES]
+    if unknown:
+        click.echo(f"unknown passes: {unknown} (valid: {ALL_PASSES})",
+                   err=True)
+        sys.exit(2)
+
+    if golden is not None:
+        sys.exit(_lint_golden(Path(golden), passes))
+
+    targets: list = []
+    for source in sources:
+        path = Path(source)
+        if path.is_dir():
+            targets.extend(sorted(path.rglob("*.json")))
+        else:
+            targets.append(path)
+    if bench_configs:
+        import runpy
+        bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+        if not bench_path.is_file():
+            click.echo(f"--bench needs a source checkout: {bench_path} "
+                       f"not found", err=True)
+            sys.exit(2)
+        bench_module = runpy.run_path(str(bench_path))
+        for name, definition in sorted(
+                bench_module["collect_definitions"]().items()):
+            targets.append((f"bench.py::{name}", definition))
+    if not targets:
+        click.echo("nothing to lint (give files, directories, or "
+                   "--bench)", err=True)
+        sys.exit(2)
+
+    report = AnalysisReport()
+    for target in targets:
+        if isinstance(target, tuple):
+            label, source = target
+        else:
+            label, source = str(target), target
+        report.extend(analyze_definition(source, passes=passes,
+                                         source_path=label))
+    rendered = (report.to_json() if fmt == "json"
+                else report.render())
+    click.echo(rendered)
+    if output:
+        Path(output).write_text(
+            rendered if rendered.endswith("\n") else rendered + "\n")
+    sys.exit(1 if report.failures(strict=strict) else 0)
+
+
+def _lint_golden(corpus: "Path", passes) -> int:
+    """Golden-corpus mode: every `<code>_*.json` in the corpus must
+    yield a finding with that code -- the proof each lint rule still
+    fires.  Returns the exit status."""
+    from .analyze import RULES, analyze_definition
+
+    failures = 0
+    checked = 0
+    for path in sorted(corpus.glob("*.json")):
+        expected = path.stem.split("_", 1)[0].upper()
+        if expected not in RULES:
+            click.echo(f"SKIP {path.name}: no rule code prefix")
+            continue
+        checked += 1
+        report = analyze_definition(path, passes=passes,
+                                    source_path=str(path))
+        codes = {diagnostic.code for diagnostic in report.findings}
+        if expected in codes:
+            click.echo(f"ok   {path.name}: {expected} fired")
+        else:
+            failures += 1
+            click.echo(f"FAIL {path.name}: expected {expected}, got "
+                       f"{sorted(codes) or 'no findings'}")
+    click.echo(f"{checked} golden definition(s), {failures} failure(s)")
+    return 1 if failures or not checked else 0
+
+
+@main.command()
 def bench() -> None:
     """Run the standard benchmark (one JSON line)."""
     import runpy

@@ -404,6 +404,26 @@ class LMGenerate(ComputeElement):
 
         return self._cached_group_kernel(max_new, build), self.state
 
+    def eval_kernel(self):
+        """Static-analyzer hook (PipelineElement.eval_kernel): greedy
+        generation as a pure kernel over a `tokens` input, with setup()
+        as the state builder -- the analyzer proves `generated` shapes
+        under jax.eval_shape without allocating the transformer."""
+        if type(self).process_frame is not LMGenerate.process_frame:
+            return None
+        self.configure()
+        if self.config.sequence_parallel:
+            return None  # sp decode needs an ambient mesh to trace
+        max_new = int(self.get_parameter("max_new_tokens", 32))
+        config = self.config
+
+        def kernel(state, tokens):
+            out, _ = generate(state, config,
+                              jnp.asarray(tokens, jnp.int32), max_new)
+            return {"generated": out}
+
+        return kernel, self.setup
+
 
 # byte-level toy vocabulary shared by SpeechToText and TokensToText:
 # 0=pad 1=sot 2=eot, 3..258 = bytes
@@ -526,6 +546,27 @@ class SpeechToText(ComputeElement):
             return kernel
 
         return self._cached_group_kernel(max_tokens, build), self.state
+
+    def eval_kernel(self):
+        """Static-analyzer hook (PipelineElement.eval_kernel): log-mel
+        frontend + transcription as a pure kernel, setup() as the state
+        builder; jax.eval_shape proves the `tokens` contract without
+        building the ASR params."""
+        if type(self).process_frame is not SpeechToText.process_frame:
+            return None
+        self.configure()
+        max_tokens = int(self.get_parameter("max_tokens", 32))
+        config = self.config
+        from ..models.asr import transcribe_audio
+
+        def kernel(state, audio):
+            audio = jnp.asarray(audio, jnp.float32)
+            if audio.ndim == 1:  # unbatched source, as in process_frame
+                audio = audio[None]
+            return {"tokens": transcribe_audio(
+                state, config, audio, max_tokens=max_tokens)}
+
+        return kernel, self.setup
 
 
 class TextToSpeech(ComputeElement):
@@ -781,3 +822,25 @@ class Detector(ComputeElement):
 
             self._group_kernel_fn = kernel
         return self._group_kernel_fn, self.state
+
+    def eval_kernel(self):
+        """Static-analyzer hook (PipelineElement.eval_kernel): the
+        detection kernel with setup() as the state builder, so
+        jax.eval_shape proves the detections contract without building
+        detector params."""
+        if type(self).process_frame is not Detector.process_frame:
+            return None
+        self.configure()
+        if self._yolo:
+            from ..models import yolo_detect as detect_fn
+        else:
+            detect_fn = detect
+        config = self.config
+
+        def kernel(state, image):
+            image = jnp.asarray(image, jnp.float32)
+            if image.ndim == 3:  # unbatched source, as in process_frame
+                image = image[None]
+            return {"detections": detect_fn(state, config, image)}
+
+        return kernel, self.setup

@@ -59,11 +59,31 @@ import hashlib
 import os
 import threading
 
-__all__ = ["FaultInjector", "create_injector", "get_injector",
-           "reset_injector"]
+from .analyze.grammar import DirectiveGrammar, Field
+
+__all__ = ["FaultInjector", "FAULTS_GRAMMAR", "create_injector",
+           "get_injector", "reset_injector"]
 
 _POINTS = ("element_raise", "fetch_drop", "reply_blackhole",
            "dispatch_delay", "connection_drop", "replica_kill")
+
+# The spec grammar above as a declarative table over the shared
+# directive-grammar core (analyze/grammar.py): parse and offline lint
+# (`aiko lint` AIKO402) validate through the SAME definition, so the
+# two can never drift.
+_RULE_FIELDS = {
+    "node": Field("str"),
+    "frame": Field("int", minimum=0),
+    "rate": Field("float", minimum=0.0, maximum=1.0),
+    "times": Field("int", minimum=-1),
+    "ms": Field("float", minimum=0.0),
+    "once": Field("flag"),
+}
+FAULTS_GRAMMAR = DirectiveGrammar(
+    "faults",
+    options={"seed": Field("int")},
+    heads={point: _RULE_FIELDS for point in _POINTS},
+    unknown_head_message="unknown fault point")
 
 
 class _Rule:
@@ -231,27 +251,10 @@ def create_injector(spec) -> FaultInjector | None:
     if not spec:
         return None
     spec = str(spec)
-    seed = 0
+    parsed = FAULTS_GRAMMAR.parse(spec)
+    seed = int(parsed.options.get("seed", 0))
     rules: dict[str, list[_Rule]] = {}
-    for part in spec.split(";"):
-        part = part.strip()
-        if not part:
-            continue
-        tokens = part.split(":")
-        head = tokens[0].strip()
-        if "=" in head:  # global option (seed=N)
-            name, _, value = head.partition("=")
-            if name.strip() == "seed":
-                seed = int(value)
-                continue
-            raise ValueError(f"unknown fault option: {head!r}")
-        if head not in _POINTS:
-            raise ValueError(
-                f"unknown fault point {head!r} (valid: {_POINTS})")
-        args = {}
-        for token in tokens[1:]:
-            key, _, value = token.partition("=")
-            args[key.strip()] = value.strip()
+    for head, args in parsed.directives:
         rules.setdefault(head, []).append(_Rule(args))
     return FaultInjector(spec, seed=seed, rules=rules)
 

@@ -73,6 +73,21 @@ class PipelineTelemetry:
             pipeline.process.event.add_timer_handler(
                 self._timer, self._interval)
 
+    # -- construction-time validation --------------------------------------
+
+    def record_lint(self, report) -> None:
+        """Static-analysis findings from construction-time validation:
+        `lint.findings` plus a per-rule-code breakdown, so fleets can
+        see definitions admitted WITH warnings (error findings never
+        get here -- they fail construction).  Recorded even with
+        telemetry disabled: this is a once-per-construction write, not
+        a per-frame one, and a disabled-telemetry fleet still wants to
+        know its definitions carry findings."""
+        findings = getattr(report, "findings", None) or []
+        self.registry.counter("lint.findings").inc(len(findings))
+        for code, count in report.by_code().items():
+            self.registry.counter(f"lint.findings.{code}").inc(count)
+
     # -- frame lifecycle ---------------------------------------------------
 
     def frame_begin(self, stream, frame) -> None:

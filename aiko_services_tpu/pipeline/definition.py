@@ -94,13 +94,51 @@ def _parse_ports(ports, element_name, direction) -> list:
     return parsed
 
 
-def parse_pipeline_definition(source) -> PipelineDefinition:
-    """source: dict, JSON text, or a path to a JSON file."""
-    if isinstance(source, (str, Path)) and str(source).endswith(".json"):
-        with open(source) as handle:
-            document = json.load(handle)
+def _looks_like_path(source) -> bool:
+    """Filesystem-path sniffing: an existing file is ALWAYS a path
+    (whatever its suffix -- definitions ship as .json, .pipeline, or
+    extensionless), and a .json suffix is a path even when the file is
+    missing, so the error names the file instead of a JSONDecodeError
+    over the path string."""
+    if isinstance(source, Path):
+        return True
+    text = str(source)
+    if text.endswith(".json"):
+        return True
+    if "\n" in text or text.lstrip()[:1] in ("{", "["):
+        return False  # JSON text, never a legal path probe
+    try:
+        return Path(text).exists()
+    except OSError:
+        return False  # e.g. a name longer than the filesystem allows
+
+
+def parse_pipeline_definition(source,
+                              validate: bool = True) -> PipelineDefinition:
+    """source: dict, JSON text, or a path to a JSON file.
+
+    `validate=False` parses the schema only (the static analyzer lints
+    unvalidated definitions so EVERY problem is reported, not just the
+    first)."""
+    if isinstance(source, (str, Path)) and _looks_like_path(source):
+        path = Path(str(source))
+        try:
+            with open(path) as handle:
+                document = json.load(handle)
+        except OSError as error:
+            raise DefinitionError(
+                f"cannot read definition file {path}: {error}") from None
+        except json.JSONDecodeError as error:
+            raise DefinitionError(
+                f"definition file {path} is not valid JSON: "
+                f"{error}") from None
     elif isinstance(source, str):
-        document = json.loads(source)
+        try:
+            document = json.loads(source)
+        except json.JSONDecodeError as error:
+            raise DefinitionError(
+                f"definition is neither an existing file nor valid "
+                f"JSON text: {error}") from None
     else:
         document = source
     _require(isinstance(document, dict), "Definition must be a JSON object")
@@ -147,67 +185,55 @@ def parse_pipeline_definition(source) -> PipelineDefinition:
         parameters=document.get("parameters", {}),
         elements=elements,
     )
-    validate_pipeline_definition(definition)
+    if validate:
+        validate_pipeline_definition(definition)
     return definition
 
 
-def validate_pipeline_definition(definition: PipelineDefinition) -> Graph:
-    """Cross-check the graph against element definitions and port linking.
+# the graph-pass rules that mirror the reference PipelineGraph.validate
+# (pipeline.py:254-286): structural wiring errors every caller of
+# parse(validate=True) has always been rejected on.  AIKO2xx spec-flow
+# errors are deliberately NOT in this set -- typed-port checking is the
+# construction-lint/`aiko lint` surface, and legacy callers parse
+# untyped definitions.
+_STRUCTURAL_CODES = frozenset(
+    ["AIKO101", "AIKO102", "AIKO103", "AIKO105", "AIKO106", "AIKO107"])
 
-    Mirrors the reference PipelineGraph.validate (pipeline.py:254-286):
-    every input of a non-head element must be produced by some predecessor's
-    output (after map_in/map_out renames) or supplied as initial frame data
-    for head elements.
-    """
-    names = [element.name for element in definition.elements]
-    _require(len(names) == len(set(names)),
-             f"Duplicate element names in {definition.name}")
+
+def validate_pipeline_definition(definition: PipelineDefinition) -> Graph:
+    """Cross-check the graph against element definitions and port
+    linking: every input of a non-head element must be produced by some
+    ancestor's output (after map_in/map_out renames) or supplied as
+    initial frame data for head elements.
+
+    The structural rules are the analyzer's graph pass
+    (analyze/graph_flow.py AIKO1xx) filtered to _STRUCTURAL_CODES, so
+    this error and `aiko lint` can never drift; the on_error grammar
+    rides the shared directive-grammar core the same way (AIKO401)."""
     # fault-tolerance grammar: a mistyped on_error would silently fall
     # back to stop_stream at runtime -- reject it at definition time,
     # wherever it is declared (pipeline-wide or per element)
+    from ..analyze.grammar import Field, GrammarError
     from .element import ERROR_POLICIES
+    on_error_field = Field("str", choices=ERROR_POLICIES)
     for scope_name, parameters in (
             [(definition.name, definition.parameters)]
             + [(element.name, element.parameters)
                for element in definition.elements]):
         on_error = (parameters or {}).get("on_error")
-        _require(
-            on_error is None or str(on_error).lower() in ERROR_POLICIES,
-            f"{scope_name}: on_error must be one of {ERROR_POLICIES}, "
-            f"got {on_error!r}")
+        if on_error is not None:
+            try:
+                on_error_field.coerce(definition.name, "on_error",
+                                      str(on_error).lower())
+            except GrammarError as error:
+                raise DefinitionError(f"{scope_name}: {error}") from None
     graph = Graph.traverse(definition.graph)
-    for node_name in graph.node_names():
-        _require(definition.element(node_name) is not None,
-                 f"Graph node '{node_name}' has no element definition")
-
-    heads = set(graph.head_nodes())
-    for node_name in graph.get_path():
-        element = definition.element(node_name)
-        if node_name in heads:
-            continue  # head inputs come from create_frame data
-        available = set()
-        for predecessor in _ancestors(graph, node_name):
-            predecessor_def = definition.element(predecessor)
-            for output_name in predecessor_def.output_names():
-                available.add(
-                    predecessor_def.map_out.get(output_name, output_name))
-        for input_name in element.input_names():
-            swag_key = element.map_in.get(input_name, input_name)
-            _require(
-                swag_key in available,
-                f"{definition.name}: element '{node_name}' input "
-                f"'{input_name}' (swag key '{swag_key}') is not produced by "
-                f"any ancestor; available: {sorted(available)}")
+    from ..analyze.graph_flow import run_graph_pass
+    report = run_graph_pass(definition, graph=graph)
+    problems = [diagnostic for diagnostic in report.findings
+                if diagnostic.code in _STRUCTURAL_CODES]
+    if problems:
+        raise DefinitionError(
+            f"{definition.name}: "
+            + "\n".join(diagnostic.render() for diagnostic in problems))
     return graph
-
-
-def _ancestors(graph: Graph, name: str) -> set:
-    result = set()
-    frontier = list(graph.predecessors(name))
-    while frontier:
-        node = frontier.pop()
-        if node in result:
-            continue
-        result.add(node)
-        frontier.extend(graph.predecessors(node))
-    return result

@@ -200,6 +200,19 @@ class PipelineElement(Actor):
         """
         return None
 
+    def eval_kernel(self):
+        """Optional abstract-interpretation hook for the static
+        analyzer (analyze/shape_eval.py): return `(kernel, state_fn)`
+        where `kernel(state, **inputs) -> dict` is the element's pure
+        device program and `state_fn()` builds its state pytree (None
+        for stateless elements).  Both are ONLY ever called under
+        jax.eval_shape, so nothing allocates, compiles, or touches a
+        device -- the analyzer synthesizes ShapeDtypeStructs from the
+        declared port specs and proves declared outputs match traced
+        outputs.  Return None (the default) when the element has no
+        pure device program (sources, host elements)."""
+        return None
+
     # -- frame creation ----------------------------------------------------
 
     def create_frame(self, stream: Stream, frame_data: dict) -> None:

@@ -249,11 +249,45 @@ class Pipeline(Actor):
         # (pipeline parameter "telemetry: false" disables ALL per-frame
         # instrument writes -- the latency operating point)
         self.telemetry = PipelineTelemetry(self)
+        # definition-time static analysis (analyze/): the cheap passes
+        # (graph/port dataflow, tensor-spec flow, policy grammars) run
+        # at construction so a shape clash or typo'd grammar fails HERE
+        # with a rule code, not mid-stream as a dead-letter.  Opt out
+        # with pipeline parameter `validate: false`; error findings
+        # raise, warnings are logged and exported through the metrics
+        # registry (`lint.findings` + per-rule counters)
+        from ..utils import truthy
+        if truthy((definition.parameters or {}).get("validate", True)):
+            self._run_construction_lint(definition)
         self._produced_keys = self._compute_produced_keys()
         self._create_elements()
         self._update_lifecycle()
 
     # -- construction ------------------------------------------------------
+
+    def _run_construction_lint(self, definition) -> None:
+        """The analyzer's cheap passes at construction: error findings
+        raise DefinitionError (the definition is wrong); warnings are
+        admitted but logged and counted through the metrics registry so
+        fleets can see how many definitions carry findings."""
+        from ..analyze import CHEAP_PASSES, analyze_definition
+        # re-runs the graph pass validate_pipeline_definition already
+        # ran: deliberate -- the passes are pure and run in
+        # microseconds, and sharing the report would couple the
+        # engine's unconditional structural validation to the
+        # opt-out-able lint surface
+        report = analyze_definition(definition, passes=CHEAP_PASSES)
+        errors = report.errors()
+        if errors:
+            from .definition import DefinitionError
+            raise DefinitionError(
+                f"{definition.name}: definition rejected by static "
+                "analysis (`validate: false` opts out):\n"
+                + "\n".join(d.render() for d in errors))
+        self.telemetry.record_lint(report)
+        for diagnostic in report.findings:
+            _LOGGER.warning("%s: lint: %s", self.name,
+                            diagnostic.render())
 
     def _compute_produced_keys(self) -> set:
         produced = set()

@@ -241,6 +241,32 @@ class ComputeElement(PipelineElement):
             for key, value in self.dynamic_parameters(stream).items()}
         return self._group_kernel_fn, (self.state, dynamic)
 
+    def eval_kernel(self):
+        """Abstract-interpretation hook for the static analyzer
+        (PipelineElement.eval_kernel contract): compute() exposed with
+        its state BUILDER so the analyzer can dry-run
+        setup-then-compute entirely under jax.eval_shape -- no
+        parameter allocation, no compile, no device.  Elements whose
+        engine path depends on runtime sizes (bucket padding, `lengths`
+        masks) or a custom process_frame fall out: compute() alone
+        would not reproduce their behavior."""
+        if (type(self).compute is ComputeElement.compute
+                or type(self).process_frame
+                is not ComputeElement.process_frame):
+            return None
+        if self._bucket_axes or "lengths" in inspect.signature(
+                self.compute).parameters:
+            return None
+        self.configure()
+
+        def kernel(state, **batch):
+            dynamic = {
+                key: jnp.asarray(value)
+                for key, value in self.dynamic_parameters(None).items()}
+            return self.compute(state, **dynamic, **batch)
+
+        return kernel, self.setup
+
     def _cached_group_kernel(self, key, build):
         """Per-static-parameter-value kernel cache for group_kernel
         overrides (e.g. one kernel per max_tokens): a STABLE kernel

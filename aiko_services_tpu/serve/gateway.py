@@ -197,7 +197,18 @@ class Gateway(Actor):
                  router_seed: int = 0, faults=None, telemetry: bool = True,
                  metrics_interval: float = 10.0):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
-        self.policy = AdmissionPolicy.parse(policy)
+        # construction-time validation through the shared
+        # directive-grammar core (analyze/grammar.py): a typo'd policy
+        # fails HERE with the lint rule code, exactly as `aiko lint`
+        # would report it offline -- never silently admits everything
+        try:
+            self.policy = AdmissionPolicy.parse(policy)
+        except ValueError as error:
+            code = ("AIKO404" if getattr(error, "kind", "") == "unknown"
+                    else "AIKO403")
+            raise ValueError(
+                f"{code}: gateway admission policy rejected: "
+                f"{error}") from None
         self.replicas: dict[str, _Replica] = {}
         self.streams: dict[str, _GatewayStream] = {}
         # parked frames: (priority, seq, stream_id, frame_id), dispatched

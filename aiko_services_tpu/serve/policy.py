@@ -37,7 +37,9 @@
 
 from __future__ import annotations
 
-__all__ = ["AdmissionPolicy", "TokenBucket"]
+from ..analyze.grammar import DirectiveGrammar, Field
+
+__all__ = ["AdmissionPolicy", "POLICY_GRAMMAR", "TokenBucket"]
 
 DEFAULT_MAX_INFLIGHT = 8
 DEFAULT_QUEUE_CAPACITY = 64
@@ -46,6 +48,38 @@ DEFAULT_STALE_AFTER_S = 15.0
 DEFAULT_THROTTLE_HIGH = 0.5
 DEFAULT_THROTTLE_LOW = 0.125
 DEFAULT_THROTTLE_RATE = 5.0
+
+
+def _parse_bucket(tail, value):
+    """`bucket:P=rate/burst` -> (priority, rate, burst); dict-shaped
+    specs may carry (rate, burst) tuples."""
+    priority = int(tail)
+    if isinstance(value, (tuple, list)):
+        rate, burst = value
+    else:
+        rate, _, burst = str(value).partition("/")
+    return priority, float(rate), float(burst or rate)
+
+
+# The grammar above as a declarative table over the shared
+# directive-grammar core (analyze/grammar.py): Gateway construction and
+# `aiko lint` (AIKO403) validate through the SAME definition.  Range
+# handling keeps the historical clamping semantics (max_inflight
+# clamps up to 1, queue down to 0) -- the grammar rejects unknown
+# directives and untypeable values, the policy clamps domains.
+POLICY_GRAMMAR = DirectiveGrammar(
+    "gateway policy",
+    options={
+        "max_inflight": Field("int"),
+        "queue": Field("int"),
+        "hysteresis": Field("float"),
+        "stale_after": Field("float"),
+        "throttle_high": Field("float"),
+        "throttle_low": Field("float"),
+        "throttle_rate": Field("float"),
+        "frame_deadline": Field("float"),
+    },
+    prefixes={"bucket": _parse_bucket})
 
 
 class TokenBucket:
@@ -102,47 +136,32 @@ class AdmissionPolicy:
             return policy
         if isinstance(spec, AdmissionPolicy):
             return spec
-        if isinstance(spec, dict):
-            items = list(spec.items())
-        else:
-            items = []
-            for part in str(spec).split(";"):
-                part = part.strip()
-                if not part:
-                    continue
-                key, sep, value = part.partition("=")
-                if not sep:
-                    raise ValueError(
-                        f"policy directive {part!r} is not key=value")
-                items.append((key.strip(), value.strip()))
+        parsed = POLICY_GRAMMAR.parse(spec)
+        if not isinstance(spec, dict):
             policy.spec = str(spec)
-        for key, value in items:
-            if key.startswith("bucket:"):
-                priority = int(key.split(":", 1)[1])
-                if isinstance(value, (tuple, list)):
-                    rate, burst = value
-                else:
-                    rate, _, burst = str(value).partition("/")
-                policy.buckets[priority] = TokenBucket(
-                    float(rate), float(burst or rate))
-            elif key == "max_inflight":
-                policy.max_inflight = max(1, int(value))
-            elif key == "queue":
-                policy.queue_capacity = max(0, int(value))
-            elif key == "hysteresis":
-                policy.hysteresis_s = max(0.0, float(value))
-            elif key == "stale_after":
-                policy.stale_after_s = max(0.0, float(value))
-            elif key == "throttle_high":
-                policy.throttle_high = float(value)
-            elif key == "throttle_low":
-                policy.throttle_low = float(value)
-            elif key == "throttle_rate":
-                policy.throttle_rate = float(value)
-            elif key == "frame_deadline":
-                policy.frame_deadline_s = max(0.0, float(value))
-            else:
-                raise ValueError(f"unknown policy directive: {key!r}")
+        clamps = {
+            "max_inflight": lambda v: max(1, v),
+            "queue": lambda v: max(0, v),
+            "hysteresis": lambda v: max(0.0, v),
+            "stale_after": lambda v: max(0.0, v),
+            "frame_deadline": lambda v: max(0.0, v),
+        }
+        attributes = {
+            "max_inflight": "max_inflight",
+            "queue": "queue_capacity",
+            "hysteresis": "hysteresis_s",
+            "stale_after": "stale_after_s",
+            "throttle_high": "throttle_high",
+            "throttle_low": "throttle_low",
+            "throttle_rate": "throttle_rate",
+            "frame_deadline": "frame_deadline_s",
+        }
+        for key, value in parsed.options.items():
+            clamp = clamps.get(key)
+            setattr(policy, attributes[key],
+                    clamp(value) if clamp else value)
+        for _, _, (priority, rate, burst) in parsed.prefixed:
+            policy.buckets[priority] = TokenBucket(rate, burst)
         if policy.throttle_low > policy.throttle_high:
             raise ValueError(
                 f"throttle_low {policy.throttle_low} must not exceed "

@@ -9,7 +9,7 @@ import importlib.util
 import sys
 from pathlib import Path
 
-__all__ = ["load_module"]
+__all__ = ["load_module", "unload_module"]
 
 _MODULES_LOADED: dict[str, object] = {}
 
@@ -30,3 +30,12 @@ def load_module(module_descriptor: str):
         module = importlib.import_module(module_descriptor)
     _MODULES_LOADED[module_descriptor] = module
     return module
+
+
+def unload_module(name: str) -> None:
+    """Drop a module from BOTH import caches (sys.modules and the
+    descriptor memo) so the next load_module(name) re-imports it."""
+    sys.modules.pop(name, None)
+    for key, module in list(_MODULES_LOADED.items()):
+        if key == name or getattr(module, "__name__", None) == name:
+            del _MODULES_LOADED[key]

@@ -255,22 +255,29 @@ def _latency_fields(p50, drain_pf, digits=2):
 
 # -- config 1: text ----------------------------------------------------------
 
-def bench_text():
-    measure = 200 if SMOKE else 2000
-    definition = {
+def _text_definition(measure):
+    return {
         "name": "bench_text",
         "graph": ["(source (transform))"],
         "elements": [
-            {"name": "source", "output": [{"name": "text"}, {"name": "t0"}],
+            {"name": "source",
+             "output": [{"name": "text", "type": "str"},
+                        {"name": "t0", "type": "float"}],
              "parameters": {"data_sources": ["hello pipeline world"],
                             "count": measure + 60, "timestamps": True},
              "deploy": _local("TextSource")},
-            {"name": "transform", "input": [{"name": "text"}],
-             "output": [{"name": "text"}],
+            {"name": "transform",
+             "input": [{"name": "text", "type": "str"}],
+             "output": [{"name": "text", "type": "str"}],
              "parameters": {"transform": "upper"},
              "deploy": _local("TextTransform")},
         ],
     }
+
+
+def bench_text():
+    measure = 200 if SMOKE else 2000
+    definition = _text_definition(measure)
     fps, p50, drain_pf, _ = _run_pipeline(
         definition, warmup=50, measure=measure, ready_key="text")
     return {"frames_per_sec": round(fps, 1),
@@ -281,6 +288,37 @@ def bench_text():
 
 
 # -- config 2: ASR -----------------------------------------------------------
+
+def _asr_definition(batch, seconds, max_tokens, preset, count):
+    samples = int(seconds * 16000)  # elements/audio_io SAMPLE_RATE
+    return {
+        "name": "bench_asr",
+        "graph": ["(tone (asr))"],
+        "elements": [
+            {"name": "tone",
+             "output": [{"name": "audio",
+                         "type": f"f32[b,{samples}]"},
+                        {"name": "t0", "type": "float"}],
+             "parameters": {"data_sources": [[440, seconds]],
+                            "data_batch_size": batch, "timestamps": True,
+                            "on_device": ON_DEVICE,
+                            "count": count},
+             "deploy": _local("ToneSource")},
+            {"name": "asr",
+             "input": [{"name": "audio", "type": f"f32[b,{samples}]"}],
+             "output": [{"name": "tokens",
+                         "type": f"i32[b,{max_tokens}]"}],
+             "parameters": {"preset": preset, "max_tokens": max_tokens,
+                            # 5 s serving chunks need a 512-frame window,
+                            # not whisper's full 30 s (1500): encoder
+                            # cost scales with the window
+                            "max_frames": 192 if SMOKE else 512,
+                            "dtype": ("float32" if SMOKE
+                                      else "bfloat16")},
+             "deploy": _local("SpeechToText")},
+        ],
+    }
+
 
 def bench_asr(peak):
     from aiko_services_tpu.models import asr_flops_per_example
@@ -296,28 +334,8 @@ def bench_asr(peak):
     seconds = 1.0 if SMOKE else 5.0
     max_tokens = 8 if SMOKE else 32
     warmup, measure = (2, 4) if SMOKE else (5, 40)
-    definition = {
-        "name": "bench_asr",
-        "graph": ["(tone (asr))"],
-        "elements": [
-            {"name": "tone", "output": [{"name": "audio"}, {"name": "t0"}],
-             "parameters": {"data_sources": [[440, seconds]],
-                            "data_batch_size": batch, "timestamps": True,
-                            "on_device": ON_DEVICE,
-                            "count": warmup + measure + 4},
-             "deploy": _local("ToneSource")},
-            {"name": "asr", "input": [{"name": "audio"}],
-             "output": [{"name": "tokens"}],
-             "parameters": {"preset": preset, "max_tokens": max_tokens,
-                            # 5 s serving chunks need a 512-frame window,
-                            # not whisper's full 30 s (1500): encoder
-                            # cost scales with the window
-                            "max_frames": 192 if SMOKE else 512,
-                            "dtype": ("float32" if SMOKE
-                                      else "bfloat16")},
-             "deploy": _local("SpeechToText")},
-        ],
-    }
+    definition = _asr_definition(batch, seconds, max_tokens, preset,
+                                 warmup + measure + 4)
     fps, p50, drain_pf, _ = _run_pipeline(
         definition, warmup=warmup, measure=measure, ready_key="tokens")
     n_frames = int(seconds * 100) // 2  # mel 10 ms hop, conv /2
@@ -332,6 +350,31 @@ def bench_asr(peak):
 
 
 # -- config 3: detector ------------------------------------------------------
+
+def _detector_definition(batch, size, preset, count):
+    return {
+        "name": "bench_det",
+        "graph": ["(camera (detector))"],
+        "elements": [
+            {"name": "camera",
+             "output": [{"name": "image",
+                         "type": f"f32[b,3,{size},{size}]"},
+                        {"name": "t0", "type": "float"}],
+             "parameters": {"data_sources": [[batch, 3, size, size]],
+                            "timestamps": True, "on_device": ON_DEVICE,
+                            "count": count},
+             "deploy": _local("ImageSource")},
+            {"name": "detector",
+             "input": [{"name": "image",
+                        "type": f"f32[b,3,{size},{size}]"}],
+             "output": [{"name": "detections", "type": "dict"}],
+             "parameters": {"preset": preset,
+                            "dtype": ("float32" if SMOKE
+                                      else "bfloat16")},
+             "deploy": _local("Detector")},
+        ],
+    }
+
 
 def bench_detector(peak):
     from aiko_services_tpu.models import detector_flops_per_image
@@ -349,23 +392,8 @@ def bench_detector(peak):
                                                "16"))
     warmup, measure = (2, 6) if SMOKE else (10, 100)
     size = config.image_size
-    definition = {
-        "name": "bench_det",
-        "graph": ["(camera (detector))"],
-        "elements": [
-            {"name": "camera", "output": [{"name": "image"}, {"name": "t0"}],
-             "parameters": {"data_sources": [[batch, 3, size, size]],
-                            "timestamps": True, "on_device": ON_DEVICE,
-                            "count": warmup + measure + 4},
-             "deploy": _local("ImageSource")},
-            {"name": "detector", "input": [{"name": "image"}],
-             "output": [{"name": "detections"}],
-             "parameters": {"preset": preset,
-                            "dtype": ("float32" if SMOKE
-                                      else "bfloat16")},
-             "deploy": _local("Detector")},
-        ],
-    }
+    definition = _detector_definition(batch, size, preset,
+                                      warmup + measure + 4)
     fps, p50, drain_pf, _ = _run_pipeline(
         definition, warmup=warmup, measure=measure, ready_key="detections")
     flops = detector_flops_per_image(config) * batch
@@ -725,37 +753,53 @@ def _multimodal_setup(name, batch, micro, max_tokens, max_new,
         lm_config = model_configs.LLAMA32_1B
         det_config = model_configs.YOLOV8N_SHAPE
         image_size = det_config.image_size
+    # typed tensor ports (analyze/ tensor-spec grammar): the symbolic
+    # batch `b` ties every stage to the same coalesced leading axis, and
+    # `aiko lint` dry-runs asr/lm/detector under jax.eval_shape against
+    # these specs -- the config-5 graph is the shipped proof the
+    # shape-flow pass verifies a real multi-stage serving graph
+    samples = int(audio_seconds * 16000)
+    audio_t = f"f32[b,{samples}]"
+    image_t = f"f32[b,3,{image_size},{image_size}]"
+    tokens_t = f"i32[b,{max_tokens}]"
+    generated_t = f"i32[b,{max_new}]"
     definition = {
         "name": name,
         "graph": ["(sources (asr (text) (lm (reply))) (detector))"],
         "elements": [
             {"name": "sources",
-             "output": [{"name": "audio"}, {"name": "image"},
-                        {"name": "t0"}],
+             "output": [{"name": "audio", "type": audio_t},
+                        {"name": "image", "type": image_t},
+                        {"name": "t0", "type": "float"}],
              "parameters": {"data_sources": [[440, audio_seconds]],
                             "image_shape": [3, image_size, image_size],
                             "data_batch_size": batch,
                             "timestamps": True, "on_device": ON_DEVICE,
                             "count": frame_count},
              "deploy": _local("MultiModalSource")},
-            {"name": "asr", "input": [{"name": "audio"}],
-             "output": [{"name": "tokens"}],
+            {"name": "asr",
+             "input": [{"name": "audio", "type": audio_t}],
+             "output": [{"name": "tokens", "type": tokens_t}],
              "parameters": asr, "deploy": _local("SpeechToText")},
-            {"name": "text", "input": [{"name": "tokens"}],
-             "output": [{"name": "text"}],
+            {"name": "text",
+             "input": [{"name": "tokens", "type": tokens_t}],
+             "output": [{"name": "text", "type": "str"}],
              "parameters": {"workers": 32},
              "deploy": _local("TokensToText")},
-            {"name": "lm", "input": [{"name": "tokens"}],
-             "output": [{"name": "generated"}],
+            {"name": "lm",
+             "input": [{"name": "tokens", "type": tokens_t}],
+             "output": [{"name": "generated", "type": generated_t}],
              "parameters": lm, "deploy": _local("LMGenerate")},
-            {"name": "reply", "input": [{"name": "tokens"}],
-             "output": [{"name": "text"}],
+            {"name": "reply",
+             "input": [{"name": "tokens", "type": generated_t}],
+             "output": [{"name": "text", "type": "str"}],
              "map_in": {"tokens": "generated"},
              "map_out": {"text": "reply"},
              "parameters": {"workers": 32},
              "deploy": _local("TokensToText")},
-            {"name": "detector", "input": [{"name": "image"}],
-             "output": [{"name": "detections"}],
+            {"name": "detector",
+             "input": [{"name": "image", "type": image_t}],
+             "output": [{"name": "detections", "type": "dict"}],
              "parameters": det, "deploy": _local("Detector")},
         ],
     }
@@ -873,6 +917,25 @@ def bench_latency(peak):
 
 # -- config 6: many-stream serving (multitude) -------------------------------
 
+def _serving_definition(name, size, pipeline_parameters,
+                        detector_parameters):
+    """The one-node serving graph shared by the multitude (config 6)
+    and gateway (`--router`) workloads."""
+    return {
+        "name": name,
+        "parameters": pipeline_parameters,
+        "graph": ["(detector)"],
+        "elements": [
+            {"name": "detector",
+             "input": [{"name": "image",
+                        "type": f"f32[b,3,{size},{size}]"}],
+             "output": [{"name": "detections", "type": "dict"}],
+             "parameters": detector_parameters,
+             "deploy": _local("Detector")},
+        ],
+    }
+
+
 def bench_serving(peak):
     """Multitude-style load: MANY concurrent streams, one small frame
     each, all hitting ONE shared detector element -- the reference's
@@ -927,17 +990,9 @@ def bench_serving(peak):
             detector_parameters.update(
                 {"on_error": "retry", "max_retries": 3,
                  "retry_backoff_ms": 1})
-        definition = {
-            "name": "bench_serving",
-            "parameters": pipeline_parameters,
-            "graph": ["(detector)"],
-            "elements": [
-                {"name": "detector", "input": [{"name": "image"}],
-                 "output": [{"name": "detections"}],
-                 "parameters": detector_parameters,
-                 "deploy": _local("Detector")},
-            ],
-        }
+        definition = _serving_definition(
+            "bench_serving", size, pipeline_parameters,
+            detector_parameters)
         process = Process(transport_kind="loopback")
         pipeline = create_pipeline(process, definition)
         responses = queue.Queue()
@@ -1071,20 +1126,11 @@ def bench_router(peak, replicas_n: int):
         for index in range(4)]
 
     def definition(name):
-        return {
-            "name": name,
-            "parameters": {"telemetry": TELEMETRY,
-                           "metrics_interval": 60.0},
-            "graph": ["(detector)"],
-            "elements": [
-                {"name": "detector", "input": [{"name": "image"}],
-                 "output": [{"name": "detections"}],
-                 "parameters": {"preset": preset, "micro_batch": micro,
-                                "dtype": ("float32" if SMOKE
-                                          else "bfloat16")},
-                 "deploy": _local("Detector")},
-            ],
-        }
+        return _serving_definition(
+            name, size,
+            {"telemetry": TELEMETRY, "metrics_interval": 60.0},
+            {"preset": preset, "micro_batch": micro,
+             "dtype": "float32" if SMOKE else "bfloat16"})
 
     # phase 1: ONE replica driven closed-loop to saturation -- the
     # capacity the overload is calibrated against
@@ -1226,6 +1272,31 @@ def bench_router(peak, replicas_n: int):
 
 # -- config 7: TTS -----------------------------------------------------------
 
+def _tts_definition(phrase, batch, count):
+    return {
+        "name": "bench_tts",
+        "graph": ["(source (tts))"],
+        "elements": [
+            {"name": "source",
+             "output": [{"name": "text", "type": "str"},
+                        {"name": "t0", "type": "float"}],
+             "parameters": {"data_sources": [phrase],
+                            "data_batch_size": batch,
+                            "timestamps": True,
+                            "count": count},
+             "deploy": _local("TextSource")},
+            {"name": "tts",
+             "input": [{"name": "text", "type": "str"}],
+             # waveform length depends on the phrase's char bucket:
+             # rank+dtype are the provable contract, the sample axis
+             # stays a wildcard
+             "output": [{"name": "audio", "type": "f32[b,*]"},
+                        {"name": "sample_rate", "type": "int"}],
+             "deploy": _local("TextToSpeech")},
+        ],
+    }
+
+
 def bench_tts(peak):
     """Text -> speech through the pipeline element (chars -> mel ->
     Griffin-Lim, ONE jit per frame batch): the last model family's
@@ -1240,22 +1311,8 @@ def bench_tts(peak):
                                                "8"))
     warmup, measure = (2, 4) if SMOKE else (5, 40)
     config = TTSConfig()
-    definition = {
-        "name": "bench_tts",
-        "graph": ["(source (tts))"],
-        "elements": [
-            {"name": "source", "output": [{"name": "text"},
-                                          {"name": "t0"}],
-             "parameters": {"data_sources": [phrase],
-                            "data_batch_size": batch,
-                            "timestamps": True,
-                            "count": (warmup + measure + 4) * batch},
-             "deploy": _local("TextSource")},
-            {"name": "tts", "input": [{"name": "text"}],
-             "output": [{"name": "audio"}, {"name": "sample_rate"}],
-             "deploy": _local("TextToSpeech")},
-        ],
-    }
+    definition = _tts_definition(phrase, batch,
+                                 (warmup + measure + 4) * batch)
     fps, p50, drain_pf, outputs = _run_pipeline(
         definition, warmup=warmup, measure=measure, ready_key="audio")
     # REAL speech seconds: the element pads prompts to power-of-two
@@ -1271,6 +1328,52 @@ def bench_tts(peak):
             "speech_sec_per_sec": round(fps * batch * seconds, 1),
             "batch": batch,
             "mfu": _mfu(fps * flops, peak)}
+
+
+def collect_definitions() -> dict:
+    """Every pipeline definition the benchmark constructs, keyed by
+    config name -- the `aiko lint --bench` / CI lint surface.  Built by
+    the SAME builders the bench entry points call, so linting these
+    lints exactly what runs (the analyzer's golden-corpus acceptance:
+    zero strict-mode findings here)."""
+    from aiko_services_tpu.models.configs import (
+        DETECTOR_TOY, YOLOV8N_SHAPE)
+
+    asr_batch = 2 if SMOKE else int(
+        os.environ.get("AIKO_BENCH_ASR_BATCH", "16"))
+    det_batch = 2 if SMOKE else int(
+        os.environ.get("AIKO_BENCH_DET_BATCH", "16"))
+    det_config = DETECTOR_TOY if SMOKE else YOLOV8N_SHAPE
+    det_preset = "toy" if SMOKE else "yolov8n"
+    rows = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_ROWS", "16"))
+    micro = 1 if SMOKE else int(os.environ.get("AIKO_BENCH_MICRO", "8"))
+    max_new = 8 if SMOKE else int(os.environ.get("AIKO_BENCH_NEW", "32"))
+    serving_micro = 4 if SMOKE else 16
+    multimodal, _, _, _ = _multimodal_setup(
+        "bench_multimodal", rows, micro, 16, max_new,
+        1.0 if SMOKE else 5.0, 16)
+    latency, _, _, _ = _multimodal_setup(
+        "bench_latency", 1 if SMOKE else 2, 1, 16, max_new,
+        1.0 if SMOKE else 5.0, 16)
+    return {
+        "text": _text_definition(200 if SMOKE else 2000),
+        "asr": _asr_definition(
+            asr_batch, 1.0 if SMOKE else 5.0, 8 if SMOKE else 32,
+            "whisper_tiny" if SMOKE else "whisper_small", 16),
+        "detector": _detector_definition(
+            det_batch, det_config.image_size, det_preset, 16),
+        "multimodal": multimodal,
+        "latency": latency,
+        "serving": _serving_definition(
+            "bench_serving", det_config.image_size,
+            {"telemetry": TELEMETRY, "metrics_interval": 60.0},
+            {"preset": det_preset, "micro_batch": serving_micro,
+             "dtype": "float32" if SMOKE else "bfloat16"}),
+        "tts": _tts_definition(
+            "hello" if SMOKE else
+            "the quick brown fox jumps over the lazy dog",
+            2 if SMOKE else 8, 16),
+    }
 
 
 # Hard cap on the FINAL printed line.  The driver records only the last

@@ -1,0 +1,57 @@
+# Deliberately-defective pipeline elements for the actor-safety lint's
+# golden corpus (tests/assets/lint_golden): each class violates exactly
+# one AIKO3xx rule so tests can prove the rule fires.  NEVER deploy
+# these in a real pipeline.
+
+import time
+
+from aiko_services_tpu.pipeline import (
+    AsyncHostElement, PipelineElement, StreamEvent)
+
+_SHARED_COUNTER = 0
+
+
+class BlockingElement(PipelineElement):
+    """AIKO301: time.sleep on the pipeline event loop."""
+
+    def process_frame(self, stream, text):
+        time.sleep(0.01)
+        return StreamEvent.OKAY, {"text": text}
+
+
+class AllowedBlockingElement(PipelineElement):
+    """AIKO301 suppressed by the inline `# aiko: allow` marker."""
+
+    def process_frame(self, stream, text):
+        time.sleep(0.001)  # aiko: allow
+        return StreamEvent.OKAY, {"text": text}
+
+
+class GlobalMutator(PipelineElement):
+    """AIKO303: cross-stream shared state mutated on the frame path."""
+
+    def process_frame(self, stream, text):
+        global _SHARED_COUNTER
+        _SHARED_COUNTER += 1
+        self.pipeline.last_text = text
+        return StreamEvent.OKAY, {"text": text}
+
+
+class TupleMutator(PipelineElement):
+    """AIKO303: shared-state attribute targets hidden inside an
+    unpacking assignment."""
+
+    def process_frame(self, stream, text):
+        self.pipeline.last_text, self.process.frames = text, 1
+        return StreamEvent.OKAY, {"text": text}
+
+
+class AsyncWithKernel(AsyncHostElement):
+    """AIKO302: an async host element cannot trace into a fused device
+    program."""
+
+    def process_async(self, stream, text):
+        return {"text": text}
+
+    def group_kernel(self, stream):
+        return (lambda context, **batch: batch), None

@@ -1,0 +1,486 @@
+# Static analysis (aiko_services_tpu/analyze): tensor-spec grammar,
+# graph/shape-flow verification, actor-safety lint, policy grammars,
+# the golden corpus of deliberately-broken definitions, and the
+# construction-time validation seam in Pipeline.__init__.
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+ASSETS = Path(__file__).parent / "assets"
+GOLDEN = ASSETS / "lint_golden"
+EXAMPLES = Path(__file__).parent.parent / "examples"
+if str(ASSETS) not in sys.path:  # lint_fixture_elements deploys
+    sys.path.insert(0, str(ASSETS))
+
+from aiko_services_tpu.analyze import (  # noqa: E402
+    ALL_PASSES, CHEAP_PASSES, RULES, GrammarError, SpecError,
+    analyze_definition, parse_port_type)
+from aiko_services_tpu.analyze.specs import check_flow  # noqa: E402
+from aiko_services_tpu.pipeline import (  # noqa: E402
+    DefinitionError, parse_pipeline_definition)
+
+ELEMENTS = "aiko_services_tpu.elements"
+
+
+def local(class_name, module=ELEMENTS):
+    return {"local": {"module": module, "class_name": class_name}}
+
+
+def tiny_definition(**overrides):
+    definition = {
+        "name": "tiny",
+        "graph": ["(source (sink))"],
+        "elements": [
+            {"name": "source",
+             "output": [{"name": "text", "type": "str"}],
+             "parameters": {"data_sources": ["x"]},
+             "deploy": local("TextSource")},
+            {"name": "sink",
+             "input": [{"name": "text", "type": "str"}],
+             "output": [{"name": "text", "type": "str"}],
+             "deploy": local("TextTransform")},
+        ],
+    }
+    definition.update(overrides)
+    return definition
+
+
+# -- tensor-spec grammar -----------------------------------------------------
+
+class TestSpecGrammar:
+    def test_tensor_spec_round_trip(self):
+        spec = parse_port_type("f32[b,3,224,224]")
+        assert spec.is_tensor
+        assert spec.dtype == "float32"
+        assert spec.dims == ("b", 3, 224, 224)
+
+    def test_long_dtype_names_and_wildcards(self):
+        spec = parse_port_type("bfloat16[b,*,d]")
+        assert spec.dtype == "bfloat16"
+        assert spec.dims == ("b", "*", "d")
+
+    def test_scalar_and_opaque(self):
+        assert parse_port_type("f32[]").dims == ()
+        assert parse_port_type("str").kind == "str"
+        assert parse_port_type(None).is_any
+        assert parse_port_type("any").is_any
+
+    @pytest.mark.parametrize("bad", [
+        "f33[2,2]", "f32[2,", "f32[-1]", "f32[2,]", "f32[a b]",
+        "notatype",
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(SpecError):
+            parse_port_type(bad)
+
+    def test_flow_dtype_rank_dim(self):
+        f = parse_port_type
+        assert check_flow(f("f32[4,4]"), f("f32[4,4]"), {}) == []
+        assert check_flow(f("f32[4,4]"), f("any"), {}) == []
+        codes = [c for c, _ in check_flow(f("f32[4,4]"),
+                                          f("i32[4,4]"), {})]
+        assert codes == ["AIKO202"]
+        codes = [c for c, _ in check_flow(f("f32[4,4]"), f("f32[4]"),
+                                          {})]
+        assert codes == ["AIKO203"]
+        codes = [c for c, _ in check_flow(f("f32[4,8]"),
+                                          f("f32[4,16]"), {})]
+        assert codes == ["AIKO204"]
+
+    def test_symbol_binds_once_per_graph(self):
+        f = parse_port_type
+        bindings = {}
+        assert check_flow(f("f32[b,4]"), f("f32[2,4]"), bindings) == []
+        assert bindings["b"][0] == 2
+        codes = [c for c, _ in check_flow(f("f32[b,9]"), f("f32[3,9]"),
+                                          bindings)]
+        assert codes == ["AIKO205"]
+
+    def test_tensor_into_opaque_clashes_but_opaques_duck_type(self):
+        f = parse_port_type
+        codes = [c for c, _ in check_flow(f("f32[4]"), f("str"), {})]
+        assert codes == ["AIKO202"]
+        assert check_flow(f("str"), f("list"), {}) == []
+
+
+# -- golden corpus -----------------------------------------------------------
+
+GOLDEN_FILES = sorted(GOLDEN.glob("*.json"))
+
+
+class TestGoldenCorpus:
+    def test_corpus_is_large_enough(self):
+        assert len(GOLDEN_FILES) >= 12
+
+    @pytest.mark.parametrize(
+        "path", GOLDEN_FILES, ids=[p.stem for p in GOLDEN_FILES])
+    def test_expected_rule_fires(self, path):
+        expected = path.stem.split("_", 1)[0].upper()
+        assert expected in RULES, f"{path.name}: bad code prefix"
+        report = analyze_definition(path, passes=ALL_PASSES,
+                                    source_path=str(path))
+        codes = {d.code for d in report.findings}
+        assert expected in codes, (
+            f"{path.name}: expected {expected}, got {sorted(codes)}")
+
+
+# -- shipped definitions are clean (strict mode) -----------------------------
+
+class TestShippedDefinitionsClean:
+    @pytest.mark.parametrize(
+        "path", sorted(EXAMPLES.glob("pipeline_*.json")),
+        ids=[p.stem for p in sorted(EXAMPLES.glob("pipeline_*.json"))])
+    def test_examples_zero_findings_strict(self, path):
+        report = analyze_definition(path, passes=ALL_PASSES,
+                                    source_path=str(path))
+        assert report.failures(strict=True) == [], report.render()
+
+    def test_bench_definitions_zero_findings_strict(self, monkeypatch):
+        import runpy
+        monkeypatch.setenv("AIKO_BENCH_SMOKE", "1")
+        bench = runpy.run_path(
+            str(Path(__file__).parent.parent / "bench.py"))
+        definitions = bench["collect_definitions"]()
+        assert len(definitions) >= 6
+        for name, definition in definitions.items():
+            report = analyze_definition(definition, passes=ALL_PASSES)
+            assert report.failures(strict=True) == [], (
+                f"{name}: {report.render()}")
+
+    def test_config5_graph_verified_by_eval_shape(self, monkeypatch):
+        """Acceptance: the full config-5 bench graph passes the
+        jax.eval_shape pass -- the three model stages actually trace
+        (not merely skip) and no declared spec disagrees."""
+        import runpy
+        monkeypatch.setenv("AIKO_BENCH_SMOKE", "1")
+        bench = runpy.run_path(
+            str(Path(__file__).parent.parent / "bench.py"))
+        definition = bench["collect_definitions"]()["multimodal"]
+        report = analyze_definition(definition, passes=ALL_PASSES)
+        traced = set(getattr(report, "traced_elements", ()))
+        assert {"asr", "lm", "detector"} <= traced, report.render()
+        assert not [d for d in report.findings
+                    if d.code in ("AIKO207", "AIKO208")], report.render()
+
+
+# -- actor-safety pass -------------------------------------------------------
+
+class TestActorSafety:
+    def fixture_definition(self, class_name):
+        return tiny_definition(elements=[
+            {"name": "source",
+             "output": [{"name": "text", "type": "str"}],
+             "parameters": {"data_sources": ["x"]},
+             "deploy": local("TextSource")},
+            {"name": "sink",
+             "input": [{"name": "text", "type": "str"}],
+             "output": [{"name": "text", "type": "str"}],
+             "deploy": local(class_name, "lint_fixture_elements")},
+        ])
+
+    def test_blocking_call_flagged(self):
+        report = analyze_definition(
+            self.fixture_definition("BlockingElement"),
+            passes=("graph", "actor"))
+        assert [d.code for d in report.findings] == ["AIKO301"]
+
+    def test_inline_allow_suppresses(self):
+        report = analyze_definition(
+            self.fixture_definition("AllowedBlockingElement"),
+            passes=("graph", "actor"))
+        assert report.findings == []
+
+    def test_lint_ignore_parameter_suppresses(self):
+        definition = self.fixture_definition("BlockingElement")
+        definition["elements"][1]["parameters"] = {
+            "lint_ignore": ["AIKO301"]}
+        report = analyze_definition(definition,
+                                    passes=("graph", "actor"))
+        assert report.findings == []
+
+    def test_shared_state_mutation_flagged(self):
+        report = analyze_definition(
+            self.fixture_definition("GlobalMutator"),
+            passes=("graph", "actor"))
+        codes = [d.code for d in report.findings]
+        assert codes.count("AIKO303") >= 2  # global + self.pipeline.*
+
+    def test_unpacking_assignment_mutation_flagged(self):
+        report = analyze_definition(
+            self.fixture_definition("TupleMutator"),
+            passes=("graph", "actor"))
+        codes = [d.code for d in report.findings]
+        assert codes.count("AIKO303") == 2, report.render()
+
+    def test_module_next_to_definition_file_resolves(self, tmp_path):
+        """Offline lint of a definition FILE must resolve `deploy`
+        modules that live next to it, without the caller arranging
+        sys.path -- and must not leave the directory importable."""
+        (tmp_path / "adjacent_fixture_elements.py").write_text(
+            "import time\n"
+            "from aiko_services_tpu.pipeline.element import "
+            "PipelineElement\n\n\n"
+            "class AdjacentBlocking(PipelineElement):\n"
+            "    def process_frame(self, stream, frame):\n"
+            "        time.sleep(1)\n"
+            "        return True, {'text': frame.inputs['text']}\n")
+        definition = self.fixture_definition("AdjacentBlocking")
+        definition["elements"][1]["deploy"] = local(
+            "AdjacentBlocking", "adjacent_fixture_elements")
+        path = tmp_path / "adjacent.json"
+        path.write_text(json.dumps(definition))
+        report = analyze_definition(path, passes=("graph", "actor"))
+        assert [d.code for d in report.findings] == ["AIKO301"], (
+            report.render())
+        assert str(tmp_path) not in sys.path
+        assert "adjacent_fixture_elements" not in sys.modules
+
+    def test_same_module_name_in_two_directories_not_cross_linted(
+            self, tmp_path):
+        """A deploy module imported from one definition's directory
+        must not shadow a SAME-NAMED module next to a definition in
+        another directory linted later in the same process."""
+        template = (
+            "{imports}\n"
+            "from aiko_services_tpu.pipeline.element import "
+            "PipelineElement\n\n\n"
+            "class LocalElement(PipelineElement):\n"
+            "    def process_frame(self, stream, frame):\n"
+            "{body}\n"
+            "        return True, {{'text': frame.inputs['text']}}\n")
+        dir_a, dir_b = tmp_path / "a", tmp_path / "b"
+        for directory, imports, body in (
+                (dir_a, "import time", "        time.sleep(1)"),
+                (dir_b, "", "        pass")):
+            directory.mkdir()
+            (directory / "local_elements.py").write_text(
+                template.format(imports=imports, body=body))
+            definition = self.fixture_definition("LocalElement")
+            definition["elements"][1]["deploy"] = local(
+                "LocalElement", "local_elements")
+            (directory / "def.json").write_text(json.dumps(definition))
+        report_a = analyze_definition(dir_a / "def.json",
+                                      passes=("graph", "actor"))
+        report_b = analyze_definition(dir_b / "def.json",
+                                      passes=("graph", "actor"))
+        assert [d.code for d in report_a.findings] == ["AIKO301"]
+        assert report_b.findings == [], report_b.render()
+
+
+# -- policy grammars (pass 4 / shared core) ----------------------------------
+
+class TestPolicyGrammars:
+    def test_faults_grammar_checks_offline(self):
+        from aiko_services_tpu.faults import FAULTS_GRAMMAR
+        assert FAULTS_GRAMMAR.check(
+            "seed=7;element_raise:node=a:rate=0.5", "AIKO402") == []
+        problems = FAULTS_GRAMMAR.check(
+            "element_raise:rate=nope", "AIKO402")
+        assert problems and problems[0][0] == "AIKO402"
+        problems = FAULTS_GRAMMAR.check("bogus_point", "AIKO402")
+        assert problems and problems[0][0] == "AIKO404"
+
+    def test_policy_grammar_checks_offline(self):
+        from aiko_services_tpu.serve.policy import POLICY_GRAMMAR
+        assert POLICY_GRAMMAR.check(
+            "max_inflight=8;bucket:2=10/4", "AIKO403") == []
+        problems = POLICY_GRAMMAR.check("max_inflight=many", "AIKO403")
+        assert problems and problems[0][0] == "AIKO403"
+        problems = POLICY_GRAMMAR.check("max_inflght=4", "AIKO403")
+        assert problems and problems[0][0] == "AIKO404"
+
+    def test_fault_injector_still_parses_through_core(self):
+        from aiko_services_tpu.faults import create_injector
+        injector = create_injector(
+            "seed=7;element_raise:node=asr:frame=3:times=1;"
+            "dispatch_delay:ms=5:rate=0.1")
+        assert injector.seed == 7
+        with pytest.raises(ValueError, match="unknown fault point"):
+            create_injector("explode_randomly")
+        with pytest.raises(ValueError):
+            create_injector("element_raise:rate=2.0")  # above maximum
+
+    def test_rate_out_of_range_rejected(self):
+        from aiko_services_tpu.faults import FAULTS_GRAMMAR
+        with pytest.raises(GrammarError):
+            FAULTS_GRAMMAR.parse("element_raise:rate=1.5")
+
+
+# -- definition-layer edge cases (satellite coverage) ------------------------
+
+class TestDefinitionEdgeCases:
+    def test_duplicate_element_names_rejected(self):
+        definition = tiny_definition()
+        definition["elements"].append(
+            dict(definition["elements"][0]))
+        definition["graph"] = ["(source (sink))"]
+        with pytest.raises(DefinitionError, match="AIKO102"):
+            parse_pipeline_definition(definition)
+
+    def test_graph_node_without_element_record_rejected(self):
+        definition = tiny_definition(graph=["(source (ghost))"])
+        with pytest.raises(DefinitionError, match="ghost"):
+            parse_pipeline_definition(definition)
+
+    def test_map_out_undeclared_port_rejected(self):
+        definition = tiny_definition()
+        definition["elements"][0]["map_out"] = {"bogus": "renamed"}
+        with pytest.raises(DefinitionError, match="map_out"):
+            parse_pipeline_definition(definition)
+
+    def test_map_in_undeclared_port_rejected(self):
+        definition = tiny_definition()
+        definition["elements"][1]["map_in"] = {"bogus": "text"}
+        with pytest.raises(DefinitionError, match="map_in"):
+            parse_pipeline_definition(definition)
+
+    def test_sharding_axis_absent_from_mesh_rejected_at_construction(
+            self):
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.pipeline import create_pipeline
+        definition = {
+            "name": "bad_axes",
+            "graph": ["(source (mlp))"],
+            "elements": [
+                {"name": "source", "output": [{"name": "tensor"}],
+                 "parameters": {"data_sources": [[8, 16]]},
+                 "deploy": local("ArraySource")},
+                {"name": "mlp", "input": [{"name": "tensor"}],
+                 "output": [{"name": "tensor"}],
+                 "sharding": {"axes": {"data": -1},
+                              "inputs": {"tensor": ["model", None]}},
+                 "deploy": local("JaxMLP")},
+            ],
+        }
+        process = Process(transport_kind="null")
+        try:
+            with pytest.raises(DefinitionError, match="AIKO206"):
+                create_pipeline(process, definition)
+        finally:
+            process.terminate()
+
+
+# -- parse_pipeline_definition source sniffing (satellite fix) ---------------
+
+class TestSourceSniffing:
+    def test_missing_json_path_names_the_file(self):
+        with pytest.raises(DefinitionError, match="no_such_dir"):
+            parse_pipeline_definition("no_such_dir/pipeline.json")
+
+    def test_existing_path_without_json_suffix_is_read(self, tmp_path):
+        path = tmp_path / "definition.pipeline"
+        path.write_text(json.dumps(tiny_definition()))
+        definition = parse_pipeline_definition(str(path))
+        assert definition.name == "tiny"
+
+    def test_unreadable_json_file_names_the_file(self, tmp_path):
+        path = tmp_path / "broken.json"
+        path.write_text("{not json")
+        with pytest.raises(DefinitionError, match="broken.json"):
+            parse_pipeline_definition(str(path))
+
+    def test_json_text_still_parses(self):
+        definition = parse_pipeline_definition(
+            json.dumps(tiny_definition()))
+        assert definition.name == "tiny"
+
+    def test_garbage_text_mentions_both_interpretations(self):
+        with pytest.raises(DefinitionError, match="neither"):
+            parse_pipeline_definition("definitely not json")
+
+
+# -- construction-time validation (Pipeline.__init__) ------------------------
+
+class TestConstructionValidation:
+    def dtype_clash_definition(self, validate=None):
+        definition = {
+            "name": "clash",
+            "graph": ["(source (sink))"],
+            "elements": [
+                {"name": "source",
+                 "output": [{"name": "x", "type": "f32[4,4]"}],
+                 "parameters": {"data_sources": ["x"]},
+                 "deploy": local("TextSource")},
+                {"name": "sink",
+                 "input": [{"name": "x", "type": "i32[4,4]"}],
+                 "output": [{"name": "x", "type": "i32[4,4]"}],
+                 "deploy": local("TextTransform")},
+            ],
+        }
+        if validate is not None:
+            definition["parameters"] = {"validate": validate}
+        return definition
+
+    def test_error_findings_fail_construction_with_rule_code(self):
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.pipeline import create_pipeline
+        process = Process(transport_kind="null")
+        try:
+            with pytest.raises(DefinitionError, match="AIKO202"):
+                create_pipeline(process, self.dtype_clash_definition())
+        finally:
+            process.terminate()
+
+    def test_validate_false_opts_out(self):
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.pipeline import create_pipeline
+        process = Process(transport_kind="null")
+        try:
+            pipeline = create_pipeline(
+                process, self.dtype_clash_definition(validate=False))
+            assert pipeline is not None
+        finally:
+            process.terminate()
+
+    def test_warnings_admitted_and_counted_in_metrics(self):
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.pipeline import create_pipeline
+        definition = {
+            "name": "warned",
+            "graph": ["(source (mid))"],
+            "elements": [
+                {"name": "source",
+                 "output": [{"name": "text", "type": "str"},
+                            {"name": "extra", "type": "str"}],
+                 "parameters": {"data_sources": ["x"]},
+                 "deploy": local("TextSource")},
+                {"name": "mid",
+                 "input": [{"name": "text", "type": "str"}],
+                 "output": [{"name": "text", "type": "str"},
+                            {"name": "extra", "type": "str"}],
+                 "deploy": local("TextTransform")},
+            ],
+        }
+        process = Process(transport_kind="null")
+        try:
+            pipeline = create_pipeline(process, definition)
+            counters = pipeline.telemetry.registry.snapshot()["counters"]
+            assert counters.get("lint.findings", 0) >= 1
+            assert counters.get("lint.findings.AIKO104", 0) >= 1
+        finally:
+            process.terminate()
+
+
+# -- report plumbing ---------------------------------------------------------
+
+class TestReport:
+    def test_json_report_shape(self):
+        report = analyze_definition(
+            GOLDEN / "aiko202_dtype_clash.json", passes=CHEAP_PASSES)
+        payload = json.loads(report.to_json())
+        assert payload["version"] == 1
+        assert payload["summary"]["errors"] >= 1
+        assert payload["summary"]["by_code"].get("AIKO202", 0) >= 1
+        finding = payload["findings"][0]
+        assert {"code", "severity", "definition", "element", "port",
+                "message", "source"} <= set(finding)
+
+    def test_readme_documents_every_rule_code(self):
+        readme = (Path(__file__).parent.parent
+                  / "README.md").read_text()
+        missing = [code for code in RULES if code not in readme]
+        assert missing == [], f"README lacks rule codes: {missing}"

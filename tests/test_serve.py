@@ -143,6 +143,22 @@ class TestAdmissionPolicy:
         defaults = AdmissionPolicy.parse(None)
         assert defaults.max_inflight == 8 and defaults.queue_capacity == 64
 
+    def test_construction_error_codes_match_offline_lint(self):
+        """Gateway construction must reject a spec with the SAME rule
+        code `aiko lint` reports offline: AIKO404 for an unknown
+        directive, AIKO403 for a bad value or cross-field violation."""
+        from aiko_services_tpu.analyze.policies import check_gateway_policy
+        process = Process(transport_kind="loopback")
+        for spec, code in (("max_inflght=4", "AIKO404"),
+                           ("max_inflight=many", "AIKO403"),
+                           ("throttle_low=0.9;throttle_high=0.1",
+                            "AIKO403")):
+            problems = check_gateway_policy(spec)
+            assert problems and problems[0][0] == code, (spec, problems)
+            with pytest.raises(ValueError, match=code):
+                Gateway(process, name=f"gw_{code}_{spec[:12]}",
+                        policy=spec)
+
     def test_unknown_directive_rejected(self):
         with pytest.raises(ValueError):
             AdmissionPolicy.parse("max_inflght=4")
