@@ -63,6 +63,7 @@ RULES = {
     "AIKO402": ("error", "invalid fault-injection spec"),
     "AIKO403": ("error", "invalid gateway admission-policy spec"),
     "AIKO404": ("error", "unknown directive in a policy grammar"),
+    "AIKO405": ("error", "invalid continuous-batching decode parameter"),
 }
 
 

@@ -14,7 +14,8 @@ from .diagnostics import AnalysisReport, Diagnostic
 from .grammar import Field
 
 __all__ = ["run_policy_pass", "check_gateway_policy",
-           "check_faults_spec", "FAULT_TOLERANCE_FIELDS"]
+           "check_faults_spec", "check_decode_parameters",
+           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -27,6 +28,73 @@ FAULT_TOLERANCE_FIELDS = {
     "frame_deadline": Field("float", minimum=0.0),
     "park_timeout": Field("float", minimum=0.0),
 }
+
+
+# The continuous-batching engine parameters (decode/, LMGenerate
+# `continuous: true`).  kv_blocks >= 2 because block 0 is the reserved
+# trash block (decode/blocks.py) -- a 1-block pool has zero allocatable
+# capacity.
+DECODE_FIELDS = {
+    "continuous": Field("flag"),
+    "decode_slots": Field("int", minimum=1),
+    "kv_block_size": Field("int", minimum=1),
+    "kv_blocks": Field("int", minimum=2),
+    "max_context": Field("int", minimum=1),
+    "eos_id": Field("int", minimum=0),
+}
+
+
+def check_decode_parameters(parameters: dict) -> list:
+    """(code, message) problems in one element's continuous-batching
+    parameter set: per-field type/bounds, plus the cross-field pool
+    sanity check (a pool that cannot hold even one completion admits
+    nothing -- every submit would raise, which should be a lint
+    finding, not a serving-time surprise)."""
+    problems = []
+    clean = {}
+    for key, field in DECODE_FIELDS.items():
+        if key not in parameters:
+            continue
+        try:
+            clean[key] = field.coerce("decode", key, parameters[key])
+        except ValueError as error:
+            problems.append(("AIKO405", str(error)))
+    if problems or not clean.get("continuous"):
+        return problems
+    block_size = clean.get("kv_block_size", 16)
+    kv_blocks = clean.get("kv_blocks")
+    max_new = parameters.get("max_new_tokens")
+    if kv_blocks is not None and max_new is not None:
+        try:
+            max_new = int(max_new)
+        except (TypeError, ValueError):
+            return problems  # max_new_tokens is not this pass's rule
+        needed = -(-(max_new + 1) // block_size)
+        if needed > kv_blocks - 1:
+            problems.append((
+                "AIKO405",
+                f"kv_blocks={kv_blocks} gives {kv_blocks - 1} "
+                f"allocatable blocks of {block_size}, but one "
+                f"completion of max_new_tokens={max_new} needs "
+                f"{needed}: no request could ever be admitted"))
+    max_context = clean.get("max_context")
+    if max_context is not None and max_new is not None:
+        # mirror DecodeEngine.__init__: max_context is rounded UP to a
+        # block multiple at runtime, so the lint must judge the rounded
+        # capacity or it rejects configs the engine accepts
+        effective = -(-max_context // block_size) * block_size
+        try:
+            if int(max_new) + 1 > effective:
+                problems.append((
+                    "AIKO405",
+                    f"max_context={max_context} (rounded to "
+                    f"{effective} = a kv_block_size={block_size} "
+                    f"multiple) cannot hold a single completion of "
+                    f"max_new_tokens={int(max_new)} plus a 1-token "
+                    f"prompt"))
+        except (TypeError, ValueError):
+            pass
+    return problems
 
 
 def _on_error_field():
@@ -77,6 +145,10 @@ def run_policy_pass(definition) -> AnalysisReport:
                 report.add(Diagnostic(
                     "AIKO401", str(error), definition=name,
                     element=element_name))
+        if any(key in parameters for key in DECODE_FIELDS):
+            for code, message in check_decode_parameters(parameters):
+                report.add(Diagnostic(code, message, definition=name,
+                                      element=element_name))
     faults_spec = (definition.parameters or {}).get("faults")
     if faults_spec:
         for code, message in check_faults_spec(faults_spec):

@@ -217,6 +217,221 @@ def bench() -> None:
     runpy.run_path(str(bench_path), run_name="__main__")
 
 
+@main.group()
+def system() -> None:
+    """One-command bootstrap: start/stop a whole local deployment
+    (registrar + dashboard + a named pipeline) as detached OS
+    processes tracked in a state file."""
+
+
+DEFAULT_STATE_FILE = ".aiko_system.json"
+
+
+def _system_state(state_file: str) -> dict:
+    import json
+    from pathlib import Path
+    path = Path(state_file)
+    if not path.is_file():
+        return {}
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def _pid_alive(pid: int) -> bool:
+    import os
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _pid_is_ours(pid: int) -> bool:
+    """Guard against pid reuse: a state file that outlives its children
+    (reboot, crash) must not let `aiko system stop` signal whatever
+    unrelated process now owns the pid.  Where /proc is unavailable the
+    check passes — liveness alone decides, as before."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as handle:
+            return b"aiko_services_tpu" in handle.read()
+    except OSError:
+        return True
+
+
+@system.command("start")
+@click.argument("definition", type=click.Path(exists=True))
+@click.option("--name", default=None, help="Pipeline service name")
+@click.option("--transport", default=None,
+              help="loopback | mqtt | null (default: auto from env)")
+@click.option("--dashboard/--no-dashboard", "with_dashboard",
+              default=False,
+              help="Also spawn the curses dashboard (opt-in: as a "
+                   "background child it shares this shell's terminal, "
+                   "so prefer `aiko dashboard` in its own terminal)")
+@click.option("--state-file", default=DEFAULT_STATE_FILE,
+              help="Where the spawned pids are recorded for `aiko "
+                   "system stop`")
+def system_start(definition: str, name: str | None,
+                 transport: str | None, with_dashboard: bool,
+                 state_file: str) -> None:
+    """Spawn registrar (+ optional dashboard) + the DEFINITION
+    pipeline.
+
+    Children are detached `python -m aiko_services_tpu <command>`
+    processes (ProcessManager with start_new_session, so they survive
+    this shell closing); the command returns immediately and `aiko
+    system stop` terminates everything it started."""
+    import json
+    import subprocess
+    import sys
+    import time
+    from pathlib import Path
+
+    from .runtime import ProcessManager
+
+    state = _system_state(state_file)
+    alive = {service: pid for service, pid
+             in (state.get("pids") or {}).items()
+             if _pid_alive(pid) and _pid_is_ours(pid)}
+    if alive:
+        click.echo(f"already running ({state_file}): {alive} -- "
+                   f"`aiko system stop` first", err=True)
+        sys.exit(1)
+
+    transport_args = (["--transport", transport] if transport else [])
+    manager = ProcessManager()
+    services = {}
+    logs = {}
+
+    def spawn(service_id, *arguments, inherit_stdio=False):
+        # own log file per child: an inherited stdout/stderr would pin
+        # any pipe on this shell open and die with its terminal.  The
+        # curses dashboard is the exception -- it NEEDS the tty.
+        if inherit_stdio:
+            child = manager.spawn(
+                service_id, sys.executable,
+                ["-m", "aiko_services_tpu", *arguments],
+                use_interpreter=False, start_new_session=True)
+        else:
+            log_path = Path(state_file).with_suffix(
+                "." + service_id.replace(":", "_") + ".log")
+            with open(log_path, "ab") as log:
+                child = manager.spawn(
+                    service_id, sys.executable,
+                    ["-m", "aiko_services_tpu", *arguments],
+                    use_interpreter=False, start_new_session=True,
+                    stdout=log, stderr=subprocess.STDOUT)
+            logs[service_id] = str(log_path)
+        services[service_id] = child.pid
+        return child
+
+    spawn("registrar", "registrar", *transport_args)
+    pipeline_args = ["pipeline", str(Path(definition).resolve()),
+                     *transport_args]
+    if name:
+        pipeline_args += ["--name", name]
+    spawn(f"pipeline:{name or Path(definition).stem}", *pipeline_args)
+    if with_dashboard:
+        if not sys.stdout.isatty():
+            click.echo("--dashboard needs a terminal (curses); "
+                       "skipping -- run `aiko dashboard` instead",
+                       err=True)
+        else:
+            spawn("dashboard", "dashboard", *transport_args,
+                  inherit_stdio=True)
+    Path(state_file).write_text(json.dumps({
+        "pids": services,
+        "logs": logs,
+        "definition": str(Path(definition).resolve()),
+        "transport": transport,
+        "started": time.time(),
+    }, indent=2) + "\n")
+    for service_id, pid in services.items():
+        log_note = (f" (log {logs[service_id]})"
+                    if service_id in logs else "")
+        click.echo(f"started {service_id}: pid {pid}{log_note}")
+    click.echo(f"state: {state_file} -- stop with `aiko system stop"
+               + (f" --state-file {state_file}`"
+                  if state_file != DEFAULT_STATE_FILE else "`"))
+
+
+@system.command("stop")
+@click.option("--state-file", default=DEFAULT_STATE_FILE)
+@click.option("--timeout", default=10.0,
+              help="Seconds to wait after SIGTERM before SIGKILL")
+def system_stop(state_file: str, timeout: float) -> None:
+    """Terminate every process `aiko system start` recorded: SIGTERM,
+    a grace wait, then SIGKILL for stragglers."""
+    import os
+    import signal
+    import sys
+    import time
+    from pathlib import Path
+
+    state = _system_state(state_file)
+    pids = state.get("pids") or {}
+    if not pids:
+        click.echo(f"nothing recorded in {state_file}", err=True)
+        sys.exit(1)
+    recycled = set()
+    for service_id, pid in pids.items():
+        if not _pid_alive(pid):
+            click.echo(f"{service_id}: pid {pid} already gone")
+        elif not _pid_is_ours(pid):
+            recycled.add(service_id)
+            click.echo(f"{service_id}: pid {pid} is no longer an "
+                       f"aiko_services_tpu process (recycled after a "
+                       f"reboot?) -- leaving it alone", err=True)
+        else:
+            try:
+                os.kill(pid, signal.SIGTERM)
+                click.echo(f"stopping {service_id}: pid {pid}")
+            except OSError as error:
+                click.echo(f"stop {service_id} pid {pid}: {error}",
+                           err=True)
+    deadline = time.monotonic() + timeout
+    remaining = {service: pid for service, pid in pids.items()
+                 if service not in recycled}
+    while remaining and time.monotonic() < deadline:
+        remaining = {service: pid for service, pid in remaining.items()
+                     if _pid_alive(pid)}
+        time.sleep(0.05)
+    for service_id, pid in remaining.items():
+        try:
+            os.kill(pid, signal.SIGKILL)
+            click.echo(f"killed {service_id}: pid {pid} (no SIGTERM "
+                       f"exit within {timeout}s)")
+        except OSError:
+            pass
+    Path(state_file).unlink(missing_ok=True)
+    click.echo("stopped")
+
+
+@system.command("status")
+@click.option("--state-file", default=DEFAULT_STATE_FILE)
+def system_status(state_file: str) -> None:
+    """Liveness of every recorded process."""
+    import sys
+    state = _system_state(state_file)
+    pids = state.get("pids") or {}
+    if not pids:
+        click.echo(f"nothing recorded in {state_file}")
+        sys.exit(1)
+    logs = state.get("logs") or {}
+    down = 0
+    for service_id, pid in pids.items():
+        alive = _pid_alive(pid)
+        down += 0 if alive else 1
+        suffix = f"  {logs[service_id]}" if service_id in logs else ""
+        click.echo(f"{service_id:24} pid {pid:<8} "
+                   f"{'up' if alive else 'DOWN'}{suffix}")
+    sys.exit(1 if down else 0)
+
+
 @main.command()
 @click.option("--port", default=None, type=int,
               help="UDP port to answer on (default 4149)")

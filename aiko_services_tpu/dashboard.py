@@ -67,6 +67,20 @@ def _pipeline_plugin(model: "DashboardModel") -> list:
             f"chained {metrics.get('chained_groups', 0)}  "
             f"compiles {metrics.get('compiles_fused', 0)}  "
             f"cohort splits {metrics.get('cohort_splits', 0)}")
+        decode = metrics.get("decode")
+        if isinstance(decode, dict):
+            # continuous-batching engine occupancy (LMGenerate
+            # `continuous: true`): the per-replica serving health row.
+            # No numeric format specs: EC-share values arrive over the
+            # S-expression wire as STRINGS (like every other line here)
+            lines.append(
+                f"decode: slots {decode.get('active_slots', 0)}  "
+                f"waiting {decode.get('waiting', 0)}  "
+                f"free blocks {decode.get('free_blocks', 0)}  "
+                f"admitted {decode.get('admitted', 0)}  "
+                f"completed {decode.get('completed', 0)}  "
+                f"preempted {decode.get('preempted', 0)}  "
+                f"deferred {decode.get('deferred', 0)}")
     else:
         lines.append("telemetry: (no summary yet -- disabled or "
                      "first interval pending; press m for live metrics)")

@@ -1,0 +1,16 @@
+# Continuous batching with paged KV: the slot-based decode engine
+# under LMGenerate's `continuous: true` mode and the serving gateway.
+#
+#   blocks.py   BlockManager -- fixed-size KV block pool bookkeeping
+#   engine.py   DecodeEngine -- slot scheduler: mid-decode admission /
+#               eviction / preemption with zero recompiles
+#
+# Device kernels live in models/transformer.py (init_paged_pool,
+# paged_prefill, paged_decode_step) next to the closed-batch generate()
+# they must stay bit-compatible with.
+
+from .blocks import BlockManager, TRASH_BLOCK      # noqa: F401
+from .engine import Completion, DecodeEngine, StepReport  # noqa: F401
+
+__all__ = ["BlockManager", "TRASH_BLOCK", "Completion", "DecodeEngine",
+           "StepReport"]

@@ -1,0 +1,446 @@
+# DecodeEngine: slot-based continuous batching over paged KV.
+#
+# The vLLM-shaped serving core the ROADMAP names (open item #2): a
+# fixed-arity array of decode SLOTS share one paged KV pool; new
+# requests are admitted at prefill boundaries into free slots,
+# finished sequences (EOS / max_new) free their slot and blocks
+# immediately, and every engine step runs ONE jit-compiled decode step
+# over all slots (models/transformer.py paged_decode_step) with
+# inactive slots masked onto the trash block -- so after warmup an
+# arbitrary admission/eviction sequence triggers ZERO recompiles, the
+# same shape-stability trick as the micro-batch scheduler's
+# zero-filler group concat.
+#
+# Scheduling policy (deliberately boring and deterministic):
+#   - admission is FIFO; a request that cannot get its prompt blocks
+#     defers (decode.deferred_admissions counts it) -- no head-of-line
+#     skipping, so caller-observed ordering is reproducible;
+#   - KV blocks are allocated LAZILY one block at a time as a slot's
+#     cursor crosses a block boundary (the paged-KV win: admitting on
+#     prompt cost instead of reserving prompt+max_new up front);
+#   - on pool exhaustion the YOUNGEST active slot is preempted
+#     (blocks freed, request requeued at the FRONT for a full
+#     re-prefill) so the oldest slot always progresses -- no livelock;
+#     greedy decode is deterministic, so a preempted request's
+#     regenerated tokens are identical and `emitted_upto` dedupes its
+#     token stream.
+#
+# Everything here runs on the event loop (host bookkeeping is a few
+# numpy writes per step); the device work is the one fused step call.
+
+from __future__ import annotations
+
+import time
+
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models import init_paged_pool, paged_decode_step, paged_prefill
+from ..utils import get_logger
+from ..utils.padding import bucket_length
+from .blocks import TRASH_BLOCK, BlockManager
+
+__all__ = ["DecodeEngine", "Completion", "StepReport"]
+
+_LOGGER = get_logger("decode_engine")
+
+
+@dataclass
+class _Request:
+    request_id: object
+    prompt: np.ndarray            # (true_len,) int32, exact tokens
+    max_new: int
+    submitted_at: float
+    generated: list = field(default_factory=list)
+    emitted_upto: int = 0         # token offsets already surfaced
+    admitted_at: float | None = None
+    first_token_at: float | None = None
+    decode_steps: int = 0
+    preemptions: int = 0
+    deferred: bool = False        # counted at most once per request
+
+
+@dataclass
+class Completion:
+    request_id: object
+    tokens: np.ndarray            # (max_new,) int32 (EOS-padded)
+    stats: dict
+
+
+@dataclass
+class StepReport:
+    completions: list = field(default_factory=list)
+    # (request_id, offset, token_id) newly surfaced this step, in
+    # decode order -- the element's token-streaming feed
+    emitted: list = field(default_factory=list)
+    admitted: int = 0
+    active: int = 0
+
+
+class _Slot:
+    __slots__ = ("request", "blocks", "seq", "true_len")
+
+    def __init__(self, request: _Request, blocks: list, seq: int,
+                 true_len: int):
+        self.request = request
+        self.blocks = blocks
+        self.seq = seq            # admission order; preemption victims
+        self.true_len = true_len  # are chosen youngest (max seq) first
+
+
+def _jit_cache_size() -> int:
+    return (paged_prefill._cache_size()
+            + paged_decode_step._cache_size())
+
+
+class DecodeEngine:
+    """Continuous-batching greedy decode over one transformer.
+
+    Shapes fixed at construction: `decode_slots` slots, a pool of
+    `kv_blocks` blocks of `kv_block_size` positions, and block tables
+    wide enough for `max_context` positions per slot.  Outputs are
+    bit-identical to the closed-batch generate() path for the same
+    prompt tokens (tests/test_decode.py proves it).
+    """
+
+    def __init__(self, params, config, *, decode_slots: int = 4,
+                 kv_block_size: int = 16, kv_blocks: int | None = None,
+                 max_context: int | None = None, eos_id: int | None = None,
+                 registry=None):
+        if decode_slots < 1:
+            raise ValueError(f"decode_slots must be >= 1, "
+                             f"got {decode_slots}")
+        self.params = params
+        self.config = config
+        self.slots_n = int(decode_slots)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        max_context = int(max_context or config.max_seq_len)
+        self.max_blocks = -(-max_context // int(kv_block_size))
+        self.max_context = self.max_blocks * int(kv_block_size)
+        if kv_blocks is None:
+            # full reservation: every slot can grow to max_context, so
+            # preemption never fires; shrink kv_blocks to oversubscribe
+            kv_blocks = self.slots_n * self.max_blocks + 1
+        self.blocks = BlockManager(int(kv_blocks), int(kv_block_size))
+        self.pool = init_paged_pool(config, self.blocks.num_blocks,
+                                    self.blocks.block_size)
+        self.tables = np.full((self.slots_n, self.max_blocks),
+                              TRASH_BLOCK, np.int32)
+        self.positions = np.zeros((self.slots_n,), np.int32)
+        self.last_tokens = np.zeros((self.slots_n, 1), np.int32)
+        self.slots: list[_Slot | None] = [None] * self.slots_n
+        self.waiting: deque[_Request] = deque()
+        self._admission_seq = 0
+        self._registry = registry
+        self.counters = {"admitted": 0, "completed": 0, "preempted": 0,
+                         "deferred_admissions": 0, "cancelled": 0,
+                         "compiles": 0}
+        self._update_gauges()
+
+    # -- submission --------------------------------------------------------
+
+    def _bucket(self, true_len: int) -> int:
+        """Prompt prefill bucket: power-of-two padding rounded up to a
+        block multiple, so the per-bucket prefill executable count stays
+        logarithmic and block scatter is exact.  Clamped to max_context
+        (itself a block multiple): a prompt whose pow2 round-up
+        overshoots a non-pow2 max_context still fits — prefill works at
+        any block-multiple length — and must not be rejected."""
+        block = self.blocks.block_size
+        padded = bucket_length(true_len, minimum=block)
+        return min(-(-padded // block) * block, self.max_context)
+
+    def submit(self, request_id, prompt_tokens, max_new_tokens: int):
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        max_new = int(max_new_tokens)
+        if prompt.size < 1:
+            raise ValueError(f"{request_id}: empty prompt")
+        if max_new < 1:
+            raise ValueError(f"{request_id}: max_new_tokens must be >= 1")
+        worst = max(self._bucket(prompt.size), prompt.size + max_new)
+        if worst > self.max_context:
+            raise ValueError(
+                f"{request_id}: prompt {prompt.size} + max_new "
+                f"{max_new} exceeds max_context {self.max_context}")
+        if self.blocks.blocks_for(worst) > self.blocks.capacity:
+            raise ValueError(
+                f"{request_id}: needs {self.blocks.blocks_for(worst)} "
+                f"KV blocks but the pool only has "
+                f"{self.blocks.capacity}; raise kv_blocks")
+        self.waiting.append(_Request(
+            request_id=request_id, prompt=prompt, max_new=max_new,
+            submitted_at=time.perf_counter()))
+        self._update_gauges()
+
+    def cancel(self, predicate) -> int:
+        """Drop every request whose request_id satisfies `predicate`
+        (waiting or mid-decode; a cancelled slot frees immediately).
+        Returns the number cancelled."""
+        cancelled = 0
+        kept = deque()
+        for request in self.waiting:
+            if predicate(request.request_id):
+                cancelled += 1
+            else:
+                kept.append(request)
+        self.waiting = kept
+        for index, slot in enumerate(self.slots):
+            if slot is not None and predicate(slot.request.request_id):
+                self._release_slot(index)
+                cancelled += 1
+        if cancelled:
+            self.counters["cancelled"] += cancelled
+            self._bump("decode.cancelled", cancelled)
+            self._update_gauges()
+        return cancelled
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(
+            slot is not None for slot in self.slots)
+
+    # -- the engine step ---------------------------------------------------
+
+    def step(self) -> StepReport:
+        """One engine tick: admit waiting requests into free slots at
+        the prefill boundary, grow/preempt block allocations, then run
+        ONE fused decode step over all slots."""
+        report = StepReport()
+        self._admit(report)
+        active = [index for index, slot in enumerate(self.slots)
+                  if slot is not None]
+        if not active:
+            self._update_gauges()
+            report.active = 0
+            return report
+        self._grow_or_preempt()
+        active = [index for index, slot in enumerate(self.slots)
+                  if slot is not None]
+        report.active = len(active)
+        if not active:
+            self._update_gauges()
+            return report
+        write_blocks = np.zeros((self.slots_n,), np.int32)
+        write_offsets = np.zeros((self.slots_n,), np.int32)
+        for index in active:
+            position = int(self.positions[index])
+            block_index = position // self.blocks.block_size
+            write_blocks[index] = self.slots[index].blocks[block_index]
+            write_offsets[index] = position % self.blocks.block_size
+        before = _jit_cache_size()
+        self.pool, next_tokens = paged_decode_step(
+            self.params, self.config, self.pool, self.tables,
+            self.positions, self.last_tokens, write_blocks,
+            write_offsets)
+        self._note_compiles(_jit_cache_size() - before)
+        next_tokens = np.asarray(next_tokens)
+        for index in active:
+            slot = self.slots[index]
+            request = slot.request
+            token = int(next_tokens[index, 0])
+            self.positions[index] += 1
+            self.last_tokens[index, 0] = token
+            request.generated.append(token)
+            request.decode_steps += 1
+            self._surface(report, request)
+            if self._finished(request):
+                report.completions.append(self._complete(index))
+        self._update_gauges()
+        return report
+
+    # -- admission / prefill ----------------------------------------------
+
+    def _admit(self, report: StepReport) -> None:
+        while self.waiting:
+            free = [index for index, slot in enumerate(self.slots)
+                    if slot is None]
+            if not free:
+                return
+            request = self.waiting[0]
+            true_len = int(request.prompt.size)
+            bucket = self._bucket(true_len)
+            needed = self.blocks.blocks_for(bucket)
+            granted = self.blocks.allocate(needed)
+            if granted is None:
+                # pool exhausted: admission DEFERS (FIFO order kept);
+                # completions free blocks, so the queue always drains.
+                # Counted once per REQUEST, not per blocked tick.
+                if not request.deferred:
+                    request.deferred = True
+                    self.counters["deferred_admissions"] += 1
+                    self._bump("decode.deferred_admissions", 1)
+                return
+            self.waiting.popleft()
+            index = free[0]
+            slot = _Slot(request, granted, self._admission_seq, true_len)
+            self._admission_seq += 1
+            self.slots[index] = slot
+            self.tables[index, :] = TRASH_BLOCK
+            self.tables[index, :needed] = granted
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :true_len] = request.prompt
+            # a preempted request's RE-admission keeps first-attempt
+            # timestamps: the caller saw its first token back then, so
+            # ttft/queue_wait/prefill stats must not absorb the retry
+            if request.admitted_at is None:
+                request.admitted_at = time.perf_counter()
+            before = _jit_cache_size()
+            self.pool, first = paged_prefill(
+                self.params, self.config, self.pool, padded,
+                self.tables[index], np.int32(true_len))
+            self._note_compiles(_jit_cache_size() - before)
+            first = int(first)
+            if request.first_token_at is None:
+                request.first_token_at = time.perf_counter()
+            request.generated.append(first)
+            self.positions[index] = true_len
+            self.last_tokens[index, 0] = first
+            self.counters["admitted"] += 1
+            report.admitted += 1
+            self._bump("decode.admitted", 1)
+            self._surface(report, request)
+            if self._finished(request):
+                report.completions.append(self._complete(index))
+
+    # -- block growth / preemption ----------------------------------------
+
+    def _grow_or_preempt(self) -> None:
+        """Ensure every active slot owns the block its next write
+        position lands in; on exhaustion preempt the youngest slot so
+        the oldest always progresses (no livelock)."""
+        order = sorted(
+            (index for index, slot in enumerate(self.slots)
+             if slot is not None),
+            key=lambda index: self.slots[index].seq)
+        for index in order:
+            slot = self.slots[index]
+            if slot is None:
+                continue  # preempted below while growing an older slot
+            needed = (int(self.positions[index])
+                      // self.blocks.block_size) + 1
+            while len(slot.blocks) < needed:
+                granted = self.blocks.allocate(1)
+                if granted is not None:
+                    slot.blocks.extend(granted)
+                    self.tables[index, len(slot.blocks) - 1] = granted[0]
+                    continue
+                victim = max(
+                    (other for other in range(self.slots_n)
+                     if self.slots[other] is not None),
+                    key=lambda other: self.slots[other].seq)
+                self._preempt(victim)
+                if victim == index:
+                    break  # this slot itself was the youngest
+
+    def _preempt(self, index: int) -> None:
+        slot = self.slots[index]
+        request = slot.request
+        _LOGGER.info("preempting slot %d (%r) after %d tokens: pool "
+                     "exhausted", index, request.request_id,
+                     len(request.generated))
+        request.preemptions += 1
+        # full recompute on re-admission: greedy decode regenerates the
+        # SAME tokens, and emitted_upto keeps the stream from repeating
+        request.generated = []
+        request.decode_steps = 0
+        self._release_slot(index)
+        self.waiting.appendleft(request)
+        self.counters["preempted"] += 1
+        self._bump("decode.preempted", 1)
+
+    def _release_slot(self, index: int) -> None:
+        slot = self.slots[index]
+        self.blocks.free(slot.blocks)
+        self.slots[index] = None
+        self.tables[index, :] = TRASH_BLOCK
+        self.positions[index] = 0
+        self.last_tokens[index, 0] = 0
+
+    # -- completion --------------------------------------------------------
+
+    def _finished(self, request: _Request) -> bool:
+        if len(request.generated) >= request.max_new:
+            return True
+        return (self.eos_id is not None
+                and request.generated[-1] == self.eos_id)
+
+    def _surface(self, report: StepReport, request: _Request) -> None:
+        while request.emitted_upto < len(request.generated):
+            offset = request.emitted_upto
+            report.emitted.append(
+                (request.request_id, offset, request.generated[offset]))
+            request.emitted_upto = offset + 1
+
+    def _complete(self, index: int) -> Completion:
+        slot = self.slots[index]
+        request = slot.request
+        now = time.perf_counter()
+        pad = self.eos_id if self.eos_id is not None else 0
+        tokens = np.full((request.max_new,), pad, np.int32)
+        tokens[:len(request.generated)] = request.generated
+        self._release_slot(index)
+        self.counters["completed"] += 1
+        self._bump("decode.completed", 1)
+        admitted_at = request.admitted_at or now
+        first_at = request.first_token_at or now
+        stats = {
+            "queue_wait_s": admitted_at - request.submitted_at,
+            "prefill_s": first_at - admitted_at,
+            "ttft_s": first_at - request.submitted_at,
+            "decode_steps": request.decode_steps,
+            "preemptions": request.preemptions,
+            "total_s": now - request.submitted_at,
+            "tokens": len(request.generated),
+        }
+        if self._registry is not None:
+            self._registry.histogram("decode.queue_wait_s").record(
+                stats["queue_wait_s"])
+            self._registry.histogram("decode.prefill_s").record(
+                stats["prefill_s"])
+            self._registry.histogram("decode.ttft_s").record(
+                stats["ttft_s"])
+            self._registry.histogram("decode.total_s").record(
+                stats["total_s"])
+            self._registry.histogram("decode.steps").record(
+                stats["decode_steps"])
+        return Completion(request.request_id, tokens, stats)
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def compile_count(self) -> int:
+        """Jit-cache signatures THIS engine's calls compiled (prefill
+        buckets + the one decode step).  The zero-recompile acceptance
+        assertion reads deltas of this across an admit/evict storm."""
+        return self.counters["compiles"]
+
+    def _note_compiles(self, delta: int) -> None:
+        if delta > 0:
+            self.counters["compiles"] += delta
+            self._bump("decode.compiles", delta)
+
+    def _bump(self, name: str, amount: int) -> None:
+        if self._registry is not None:
+            self._registry.counter(name).inc(amount)
+
+    def _update_gauges(self) -> None:
+        if self._registry is None:
+            return
+        self._registry.gauge("decode.active_slots").set(
+            sum(1 for slot in self.slots if slot is not None))
+        self._registry.gauge("decode.free_blocks").set(
+            self.blocks.free_count)
+        self._registry.gauge("decode.waiting").set(len(self.waiting))
+
+    def stats(self) -> dict:
+        return {
+            "active_slots": sum(1 for slot in self.slots
+                                if slot is not None),
+            "free_blocks": self.blocks.free_count,
+            "waiting": len(self.waiting),
+            "slots": self.slots_n,
+            "blocks": self.blocks.capacity,
+            "block_size": self.blocks.block_size,
+            **self.counters,
+        }

@@ -199,6 +199,11 @@ class LMGenerate(ComputeElement):
 
     def __init__(self, process, pipeline, definition):
         super().__init__(process, pipeline, definition)
+        # continuous-mode engine state: present (None/empty) from
+        # construction so observers can poll without racing the first
+        # frame's lazy _ensure_engine()
+        self._engine = None
+        self._engine_frames = {}
         # subscribe at CONSTRUCTION (not lazy setup): detections published
         # before the first frame must still be visible to that frame's
         # prompt, like the reference's init-time subscription
@@ -281,26 +286,35 @@ class LMGenerate(ComputeElement):
             init_cache(self.config, batch, max_len=max_len), self.mesh,
             filter_specs(cache_specs(sequence_parallel=True), self.mesh))
 
+    def _encode_prompts(self, stream, text):
+        """Text prompts -> left-padded (B, W) int32 token matrix plus the
+        post-template prompt strings.  ONE definition shared by the
+        closed-batch and continuous paths, so the two modes tokenize --
+        and therefore generate -- identically."""
+        prompts = [text] if isinstance(text, str) else list(text)
+        if self.tokenizer is None:
+            raise ValueError("text input needs a tokenizer parameter")
+        prompts = [self._format_prompt(stream, prompt)
+                   for prompt in prompts]
+        encoded = [self.tokenizer.encode(p, bos=True) for p in prompts]
+        width = max(len(ids) for ids in encoded)
+        pad = self.tokenizer.pad_id or 0
+        tokens = np.full((len(encoded), width), pad, np.int32)
+        for row, ids in enumerate(encoded):
+            tokens[row, width - len(ids):] = ids  # left-pad
+        return tokens, prompts
+
     def process_frame(self, stream, tokens=None, text=None):
         import contextlib
+        if self.engine_managed(stream):
+            return self._process_frame_continuous(stream, tokens, text)
         self._ensure_ready()
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
         formatted = None
         if tokens is None:
             if text is None:
                 raise ValueError("LMGenerate needs tokens or text input")
-            prompts = [text] if isinstance(text, str) else list(text)
-            if self.tokenizer is None:
-                raise ValueError("text input needs a tokenizer parameter")
-            prompts = [self._format_prompt(stream, prompt)
-                       for prompt in prompts]
-            formatted = prompts
-            encoded = [self.tokenizer.encode(p, bos=True) for p in prompts]
-            width = max(len(ids) for ids in encoded)
-            pad = self.tokenizer.pad_id or 0
-            tokens = np.full((len(encoded), width), pad, np.int32)
-            for row, ids in enumerate(encoded):
-                tokens[row, width - len(ids):] = ids  # left-pad
+            tokens, formatted = self._encode_prompts(stream, text)
         tokens = _as_device_array(tokens, jnp.int32)
         pad = ((self.tokenizer.pad_id or 0)
                if self.tokenizer is not None else 0)
@@ -366,6 +380,217 @@ class LMGenerate(ComputeElement):
                               for row in np.asarray(out)]
         return StreamEvent.OKAY, result
 
+    # -- continuous batching (decode/ engine) ------------------------------
+    #
+    # `continuous: true` swaps the whole-completion jit (prefill +
+    # fori_loop above) for the slot-based DecodeEngine: each frame's
+    # rows are SUBMITTED as requests and the frame parks
+    # (StreamEvent.PENDING) while the engine interleaves its decode
+    # steps with every other in-flight frame's.  The pump rides the
+    # element's own mailbox -- one device step per message -- so new
+    # frames arriving on the pipeline mailbox are admitted into the
+    # RUNNING decode loop at prefill boundaries instead of convoying
+    # behind a closed batch.  Completions resume their frame through
+    # the ordinary process_frame_response path, bit-identical to the
+    # closed-batch output for the same token rows.
+
+    def engine_managed(self, stream):
+        from ..utils import truthy
+        return truthy(self.get_parameter("continuous", False, stream))
+
+    def _ensure_engine(self):
+        engine = getattr(self, "_engine", None)
+        if engine is not None:
+            return engine
+        self._ensure_ready()
+        if self.mesh is not None or self.config.sequence_parallel:
+            raise ValueError(
+                f"{self.definition.name}: continuous mode runs the paged "
+                f"decode engine single-device; drop the sharding mesh / "
+                f"sequence_parallel or use the closed-batch path")
+        from ..decode import DecodeEngine
+        telemetry = getattr(self.pipeline, "telemetry", None)
+        registry = (telemetry.registry if telemetry is not None
+                    and telemetry.enabled else None)
+        kv_blocks = self.get_parameter("kv_blocks")
+        max_context = self.get_parameter("max_context")
+        eos_id = self.get_parameter("eos_id")
+        self._engine = DecodeEngine(
+            self.state, self.config,
+            decode_slots=int(self.get_parameter("decode_slots", 4)),
+            kv_block_size=int(self.get_parameter("kv_block_size", 16)),
+            kv_blocks=int(kv_blocks) if kv_blocks else None,
+            max_context=int(max_context) if max_context else None,
+            eos_id=int(eos_id) if eos_id is not None else None,
+            registry=registry)
+        self._engine_frames = {}
+        self._pump_posted = False
+        return self._engine
+
+    def _process_frame_continuous(self, stream, tokens, text):
+        import time
+        engine = self._ensure_engine()
+        formatted = None
+        if tokens is None:
+            if text is None:
+                raise ValueError("LMGenerate needs tokens or text input")
+            tokens, formatted = self._encode_prompts(stream, text)
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        max_new = int(self.get_parameter("max_new_tokens", 32, stream))
+        key = (stream.stream_id, stream.current_frame_id)
+        from ..utils import truthy
+        self._engine_frames[key] = {
+            "rows": tokens.shape[0], "done": {},
+            "formatted": formatted, "max_new": max_new,
+            "submitted_at": time.perf_counter(),
+            "stream_tokens": truthy(self.get_parameter(
+                "stream_tokens", False, stream)),
+            "chunk": max(1, int(self.get_parameter(
+                "stream_chunk", 8, stream))),
+            "buffers": {},
+        }
+        # submission order == row order; the engine's FIFO admission
+        # keeps caller-observed ordering deterministic.  A rejected row
+        # (e.g. prompt + max_new over max_context) must not leak the
+        # frame entry or strand already-queued sibling rows
+        try:
+            for row in range(tokens.shape[0]):
+                engine.submit(key + (row,), tokens[row], max_new)
+        except ValueError:
+            del self._engine_frames[key]
+            engine.cancel(lambda rid: rid[:2] == key)
+            raise
+        self._schedule_pump()
+        return StreamEvent.PENDING, None
+
+    def _schedule_pump(self):
+        """At most ONE pump message in flight: each tick runs one fused
+        decode step and re-posts itself while the engine has work, so
+        the mailbox interleaves admissions with decode progress."""
+        if not getattr(self, "_pump_posted", False):
+            self._pump_posted = True
+            self.post_message("_engine_pump", [])
+
+    def _engine_pump(self):
+        self._pump_posted = False
+        engine = getattr(self, "_engine", None)
+        if engine is None:
+            return
+        try:
+            report = engine.step()
+            for request_id, offset, token in report.emitted:
+                self._buffer_streamed_token(request_id, token)
+            for completion in report.completions:
+                self._finish_request(completion)
+        except Exception as error:
+            # the mailbox swallows exceptions, so an unguarded failure
+            # here (device error, tokenizer crash) would strand every
+            # PENDING frame with the pump never re-posted
+            self._fail_engine_frames(error)
+            return
+        if engine.has_work():
+            self._schedule_pump()
+
+    def _fail_engine_frames(self, error):
+        """Engine failure: every in-flight frame gets an error response
+        (the AsyncHostElement contract, element.py) so streams apply
+        their on_error policy instead of hanging; the engine is dropped
+        and lazily rebuilt by the next continuous frame."""
+        _LOGGER.error("%s: decode engine failed, releasing %d in-flight "
+                      "frame(s): %s", self.definition.name,
+                      len(self._engine_frames), error)
+        frames, self._engine_frames = self._engine_frames, {}
+        self._engine = None
+        for stream_id, frame_id in frames:
+            self.pipeline.post_message("process_frame_response", [
+                {"stream_id": stream_id, "frame_id": frame_id,
+                 "node": self.definition.name, "event": "error"}, {}])
+
+    def _buffer_streamed_token(self, request_id, token):
+        entry = self._engine_frames.get(request_id[:2])
+        if entry is None or not entry["stream_tokens"]:
+            return
+        row = request_id[2]
+        buffer = entry["buffers"].setdefault(row, [0, []])
+        buffer[1].append(int(token))
+        if len(buffer[1]) >= entry["chunk"]:
+            self._flush_stream_buffer(request_id[:2], entry, row)
+
+    def _flush_stream_buffer(self, key, entry, row):
+        """Publish one token chunk for one request row:
+        `(token_chunk stream_id frame_id row offset payload)` -- offset
+        is the row's completion-token offset of the chunk's first token
+        (a preempted request's regenerated tokens are never re-emitted,
+        so offsets stay gapless).  Deliberately NOT the closed-batch
+        `(tokens stream_id offset payload)` command: one command name,
+        one schema."""
+        start, chunk = entry["buffers"].pop(row, (0, []))
+        if not chunk:
+            return
+        payload = ([self.tokenizer.decode(np.asarray(chunk, np.int32))]
+                   if self.tokenizer is not None else [chunk])
+        self.publish_out("token_chunk",
+                         [key[0], key[1], row, start, payload])
+        entry["buffers"][row] = [start + len(chunk), []]
+
+    def _finish_request(self, completion):
+        import time
+        stream_id, frame_id, row = completion.request_id
+        key = (stream_id, frame_id)
+        entry = self._engine_frames.get(key)
+        if entry is None:
+            return  # stream destroyed mid-decode; engine.cancel raced
+        if entry["stream_tokens"]:
+            self._flush_stream_buffer(key, entry, row)
+            entry["buffers"].pop(row, None)
+        entry["done"][row] = completion
+        if len(entry["done"]) < entry["rows"]:
+            return
+        # entry stays registered until the response is POSTED: a crash
+        # in decode/telemetry below must leave the key visible to
+        # _fail_engine_frames or the frame would park forever
+        out = np.stack([entry["done"][r].tokens
+                        for r in range(entry["rows"])])
+        outputs = {"generated": out}
+        if entry["formatted"] is not None:
+            outputs["prompt"] = entry["formatted"]
+        if self.tokenizer is not None:
+            outputs["text"] = [self.tokenizer.decode(np.asarray(r))
+                               for r in out]
+        stats = [entry["done"][r].stats for r in range(entry["rows"])]
+        pipeline = self.pipeline
+        telemetry = getattr(pipeline, "telemetry", None)
+        if telemetry is not None:
+            stream = pipeline.streams.get(stream_id)
+            frame = (stream.frames.get(frame_id)
+                     if stream is not None else None)
+            if frame is not None:
+                telemetry.record_engine_frame(
+                    frame, self.definition.name, stats)
+        pipeline.post_message("process_frame_response", [
+            {"stream_id": stream_id, "frame_id": frame_id,
+             "node": self.definition.name,
+             "time": time.perf_counter() - entry["submitted_at"]},
+            outputs])
+        del self._engine_frames[key]
+
+    def stop_stream(self, stream, stream_id):
+        engine = getattr(self, "_engine", None)
+        if engine is not None:
+            for key in [key for key in self._engine_frames
+                        if key[0] == stream_id]:
+                del self._engine_frames[key]
+            engine.cancel(lambda rid: rid[0] == stream_id)
+        return super().stop_stream(stream, stream_id)
+
+    def engine_stats(self) -> dict | None:
+        """Live engine occupancy (dashboard / tests); None before the
+        first continuous frame."""
+        engine = getattr(self, "_engine", None)
+        return None if engine is None else engine.stats()
+
     def compute(self, state, **inputs):  # pragma: no cover
         raise NotImplementedError("LMGenerate overrides process_frame")
 
@@ -387,7 +612,8 @@ class LMGenerate(ComputeElement):
         if (self.mesh is not None or self.config.sequence_parallel
                 or self.tokenizer is not None
                 or truthy(self.get_parameter(
-                    "stream_tokens", False, stream))):
+                    "stream_tokens", False, stream))
+                or self.engine_managed(stream)):
             return None
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
 

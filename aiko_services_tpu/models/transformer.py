@@ -39,6 +39,7 @@ __all__ = [
     "init_cache", "cache_specs", "decode_step", "generate",
     "generate_stream", "make_train_step", "count_params",
     "quantize_weights_int8", "quantized_param_specs",
+    "init_paged_pool", "paged_prefill", "paged_decode_step",
 ]
 
 
@@ -519,6 +520,49 @@ def _switch_moe_gather(config: TransformerConfig, layer, x):
     return (out * weight).astype(x.dtype), aux
 
 
+def _embed(params: dict, config: TransformerConfig, tokens):
+    """Token embedding gather shared by forward() and the paged decode
+    path (one definition, so the two can never drift bitwise).
+    mode="clip": out-of-vocab ids clamp to the last row instead of
+    jnp.take's default FILL mode, whose NaN embeddings silently poison
+    every downstream activation."""
+    h = jnp.take(params["embed"]["w"], tokens, axis=0, mode="clip")
+    if h.dtype == jnp.int8:
+        # int8 embed (quantize_weights_int8): gather the rows' scales
+        # alongside and dequantize only the gathered tokens
+        h = (h.astype(jnp.float32)
+             * jnp.take(params["embed"]["w_scale"], tokens, axis=0,
+                        mode="clip")).astype(config.jnp_dtype)
+    return h
+
+
+def _mlp_block(config: TransformerConfig, layer, mlp_in):
+    """One layer's FFN (dense SwiGLU or switch MoE), shared by
+    forward() and the paged decode path.  Returns (output, aux)."""
+    if config.n_experts > 0:
+        return _switch_moe(config, layer, mlp_in)
+    return dense(
+        layer["w_down"],
+        jax.nn.silu(dense(layer["w_gate"], mlp_in))
+        * dense(layer["w_up"], mlp_in)), jnp.zeros((), jnp.float32)
+
+
+def _lm_head(params: dict, config: TransformerConfig, h):
+    """Output norm + logits head shared by forward() and the paged
+    decode path.  Untied output head when the checkpoint ships one
+    (Llama-3-8B+, models/weights.py load_llama_params); tied embedding
+    otherwise."""
+    h = rms_norm(params["norm_out"], h, config.norm_eps)
+    head = params.get("lm_head", params["embed"])
+    logits = jnp.einsum("bld,vd->blv", h.astype(jnp.float32),
+                        head["w"].astype(jnp.float32))
+    if head["w"].dtype == jnp.int8:
+        # per-row scales factor out of the contraction: the einsum
+        # streams 8-bit codes, the (V,) scale applies to the result
+        logits = logits * head["w_scale"][:, 0]
+    return logits
+
+
 def forward(params: dict, config: TransformerConfig, tokens,
             cache: dict | None = None, pos: int = 0,
             activation_specs: bool = False, return_aux: bool = False):
@@ -540,16 +584,7 @@ def forward(params: dict, config: TransformerConfig, tokens,
         names = jax.sharding.get_abstract_mesh().axis_names
         act_spec = P("data" if "data" in names else None,
                      "seq" if "seq" in names else None, None)
-    # mode="clip": out-of-vocab ids clamp to the last row instead of
-    # jnp.take's default FILL mode, whose NaN embeddings silently poison
-    # every downstream activation
-    h = jnp.take(params["embed"]["w"], tokens, axis=0, mode="clip")
-    if h.dtype == jnp.int8:
-        # int8 embed (quantize_weights_int8): gather the rows' scales
-        # alongside and dequantize only the gathered tokens
-        h = (h.astype(jnp.float32)
-             * jnp.take(params["embed"]["w_scale"], tokens, axis=0,
-                        mode="clip")).astype(config.jnp_dtype)
+    h = _embed(params, config, tokens)
     if activation_specs:
         h = jax.lax.with_sharding_constraint(h, act_spec)
     positions = pos + jnp.arange(tokens.shape[1])
@@ -571,15 +606,9 @@ def forward(params: dict, config: TransformerConfig, tokens,
                            else layer_cache.get("v_scale")),
             pos=pos)
         h = h + attn_out
-        mlp_in = rms_norm(layer["mlp_norm"], h, config.norm_eps)
-        if config.n_experts > 0:
-            mlp_out, aux = _switch_moe(config, layer, mlp_in)
-            aux_sum = aux_sum + aux
-        else:
-            mlp_out = dense(
-                layer["w_down"],
-                jax.nn.silu(dense(layer["w_gate"], mlp_in))
-                * dense(layer["w_up"], mlp_in))
+        mlp_out, aux = _mlp_block(
+            config, layer, rms_norm(layer["mlp_norm"], h, config.norm_eps))
+        aux_sum = aux_sum + aux
         h = h + mlp_out
         if activation_specs:
             h = jax.lax.with_sharding_constraint(h, act_spec)
@@ -601,16 +630,7 @@ def forward(params: dict, config: TransformerConfig, tokens,
     else:
         (h, aux_sum), new_cache = jax.lax.scan(
             layer_step, (h, aux0), (params["layers"], cache))
-    h = rms_norm(params["norm_out"], h, config.norm_eps)
-    # untied output head when the checkpoint ships one (Llama-3-8B+,
-    # models/weights.py load_llama_params); tied embedding otherwise
-    head = params.get("lm_head", params["embed"])
-    logits = jnp.einsum("bld,vd->blv", h.astype(jnp.float32),
-                        head["w"].astype(jnp.float32))
-    if head["w"].dtype == jnp.int8:
-        # per-row scales factor out of the contraction: the einsum
-        # streams 8-bit codes, the (V,) scale applies to the result
-        logits = logits * head["w_scale"][:, 0]
+    logits = _lm_head(params, config, h)
     if new_cache is None:
         if return_aux:
             return logits, aux_sum / max(config.n_layers, 1)
@@ -720,6 +740,183 @@ def generate_stream(params, config: TransformerConfig, prompt,
             jnp.int32(prompt_len + produced - 1), int(size))
         yield produced, jax.device_get(block)
         produced += size
+
+
+# -- paged KV: the continuous-batching decode substrate ----------------------
+#
+# The fori_loop generate() above is a CLOSED batch: every sequence in
+# the jit must finish before any new request touches the chip.  The
+# decode/ subsystem replaces the per-request cache with one fixed-size
+# POOL of KV blocks plus per-slot block tables, so requests are
+# admitted and evicted mid-decode without ever changing an array shape
+# (the same zero-filler trick the micro-batch scheduler uses for group
+# arity).  Three invariants make it bit-compatible with generate():
+#
+#   - block contents are written by the SAME forward()/_quantize_kv
+#     math as the contiguous cache (prefill literally reshapes a
+#     forward() cache into blocks);
+#   - the decode step's attention is the SAME masked einsum as
+#     _attention's cached branch, applied to the block-table gather --
+#     positions beyond a slot's cursor hold garbage (stale or trash)
+#     but are masked to exactly zero weight, like the zeros of a fresh
+#     contiguous cache;
+#   - inactive slots compute on a reserved TRASH block (index 0, never
+#     allocated) so the step's shapes -- (slots, max_blocks) -- are
+#     compile-time constants across any admission/eviction sequence.
+
+def init_paged_pool(config: TransformerConfig, num_blocks: int,
+                    block_size: int) -> dict:
+    """Preallocated paged KV pool: `num_blocks` blocks of `block_size`
+    token positions each, shared by every decode slot through per-slot
+    block tables.  Block 0 is the engine's reserved trash block
+    (inactive-slot writes land there).  Same leaf names/dtypes as
+    init_cache, so the int8 KV path carries over unchanged."""
+    shape = (config.n_layers, num_blocks, config.n_kv_heads, block_size,
+             config.head_dim)
+    if config.kv_dtype == "int8":
+        scale_shape = shape[:-1] + (1,)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(scale_shape, jnp.float32),
+                "v": jnp.zeros(shape, jnp.int8),
+                "v_scale": jnp.zeros(scale_shape, jnp.float32)}
+    return {"k": jnp.zeros(shape, config.jnp_dtype),
+            "v": jnp.zeros(shape, config.jnp_dtype)}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def paged_prefill(params, config: TransformerConfig, pool, prompt,
+                  table_row, true_len):
+    """Prefill one request into its pool blocks.  prompt is (1, Lb)
+    with Lb a multiple of the pool's block size (the engine right-pads
+    to a bucket, so one executable serves every prompt length in the
+    bucket); table_row (max_blocks,) names the slot's blocks, of which
+    the first Lb//block_size receive the prompt's K/V.  Returns
+    (pool, first_token) where first_token is the greedy token after the
+    TRUE prompt length -- causal masking makes logits at true_len-1
+    independent of the right-padding.  One executable per bucket; the
+    decode loop never recompiles (paged_decode_step below)."""
+    block_size = pool["k"].shape[3]
+    local = init_cache(config, 1, max_len=prompt.shape[1])
+    logits, local = forward(params, config, prompt, cache=local, pos=0)
+    first = jnp.argmax(logits[0, true_len - 1]).astype(jnp.int32)
+    blocks = prompt.shape[1] // block_size
+    new_pool = {}
+    for name, written in local.items():
+        # (nl, 1, H, Lb, d) -> (nl, blocks, H, block_size, d), scattered
+        # into the slot's first `blocks` pool entries
+        entry = written[:, 0]
+        layers, heads, _, depth = entry.shape
+        entry = entry.reshape(layers, heads, blocks, block_size,
+                              depth).transpose(0, 2, 1, 3, 4)
+        new_pool[name] = pool[name].at[:, table_row[:blocks]].set(entry)
+    return new_pool, first
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def paged_decode_step(params, config: TransformerConfig, pool, tables,
+                      positions, tokens, write_blocks, write_offsets):
+    """ONE greedy decode step over ALL slots of a continuous-batching
+    engine.  tables (slots, max_blocks) int32 maps each slot's logical
+    positions onto pool blocks; positions (slots,) is each slot's next
+    write position; tokens (slots, 1) the previous greedy token;
+    write_blocks/write_offsets (slots,) the precomputed pool location
+    of this step's K/V (the engine points INACTIVE slots at the trash
+    block, so the call is shape-stable across any admit/evict
+    sequence -- zero recompiles after the first step).  Returns
+    (pool, next_tokens (slots, 1)); inactive rows are garbage the
+    engine ignores.
+
+    Per-slot positions (unlike forward's scalar `pos`) are the whole
+    point: slot 3 can be 400 tokens into its completion while slot 0 is
+    on its first -- the rotary phase and causal mask resolve per row."""
+    block_size = pool["k"].shape[3]
+    quantized = config.kv_dtype == "int8"
+    h = _embed(params, config, tokens)
+    cos, sin = rotary_embedding(positions, config.head_dim,
+                                config.rope_theta)
+    cos, sin = cos[:, None, None, :], sin[:, None, None, :]
+    slots = tokens.shape[0]
+    hd = config.head_dim
+    repeats = config.n_heads // config.n_kv_heads
+
+    def gather(pool_layer):
+        # (num_blocks, H, bs, d)[tables] -> (S, MB, H, bs, d) -> the
+        # slot's contiguous cache view (S, H, MB*bs, d)
+        view = pool_layer[tables]
+        s, max_blocks, heads, _, depth = view.shape
+        return view.transpose(0, 2, 1, 3, 4).reshape(
+            s, heads, max_blocks * block_size, depth)
+
+    def layer_step(carry, xs):
+        h = carry
+        if quantized:
+            layer, pool_k, k_scale, pool_v, v_scale = xs
+        else:
+            layer, pool_k, pool_v = xs
+        x = rms_norm(layer["attn_norm"], h, config.norm_eps)
+        q = dense(layer["wq"], x).reshape(
+            slots, 1, config.n_heads, hd).transpose(0, 2, 1, 3)
+        k = dense(layer["wk"], x).reshape(
+            slots, 1, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        v = dense(layer["wv"], x).reshape(
+            slots, 1, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        if quantized:
+            k, k_scale_new = _quantize_kv(k)
+            v, v_scale_new = _quantize_kv(v)
+            k_scale = k_scale.at[write_blocks, :, write_offsets, :].set(
+                k_scale_new[:, :, 0, :])
+            v_scale = v_scale.at[write_blocks, :, write_offsets, :].set(
+                v_scale_new[:, :, 0, :])
+        pool_k = pool_k.at[write_blocks, :, write_offsets, :].set(
+            k[:, :, 0, :])
+        pool_v = pool_v.at[write_blocks, :, write_offsets, :].set(
+            v[:, :, 0, :])
+        if quantized:
+            # dequantize into the einsum operand load, exactly as the
+            # contiguous int8 cache path does
+            k_eff = (gather(pool_k).astype(jnp.float32)
+                     * gather(k_scale)).astype(q.dtype)
+            v_eff = (gather(pool_v).astype(jnp.float32)
+                     * gather(v_scale)).astype(q.dtype)
+        else:
+            k_eff, v_eff = gather(pool_k), gather(pool_v)
+        k_full = repeat_kv(k_eff, repeats)
+        v_full = repeat_kv(v_eff, repeats)
+        scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_full,
+                            preferred_element_type=jnp.float32) * scale
+        k_pos = jnp.arange(k_full.shape[2])[None, None, None, :]
+        q_pos = positions[:, None, None, None]
+        logits = jnp.where(k_pos <= q_pos, logits, -1e30)
+        weights = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd",
+                         weights.astype(v_full.dtype), v_full)
+        out = out.transpose(0, 2, 1, 3).reshape(slots, 1, -1)
+        h = h + dense(layer["wo"], out)
+        mlp_out, _ = _mlp_block(
+            config, layer, rms_norm(layer["mlp_norm"], h, config.norm_eps))
+        h = h + mlp_out
+        if quantized:
+            return h, (pool_k, k_scale, pool_v, v_scale)
+        return h, (pool_k, pool_v)
+
+    if quantized:
+        xs = (params["layers"], pool["k"], pool["k_scale"], pool["v"],
+              pool["v_scale"])
+    else:
+        xs = (params["layers"], pool["k"], pool["v"])
+    h, updated = jax.lax.scan(layer_step, h, xs)
+    if quantized:
+        new_pool = {"k": updated[0], "k_scale": updated[1],
+                    "v": updated[2], "v_scale": updated[3]}
+    else:
+        new_pool = {"k": updated[0], "v": updated[1]}
+    logits = _lm_head(params, config, h)
+    next_tokens = jnp.argmax(logits[:, -1], axis=-1).astype(
+        jnp.int32)[:, None]
+    return new_pool, next_tokens
 
 
 # -- training ---------------------------------------------------------------

@@ -187,6 +187,12 @@ class MetricsRegistry:
                 instrument = self._gauges[name] = Gauge()
         return instrument
 
+    def has_gauge(self, name: str) -> bool:
+        """Existence probe WITHOUT the get-or-create side effect of
+        gauge() -- for consumers that only want to know whether a
+        subsystem (e.g. the decode engine) registered itself."""
+        return name in self._gauges or _safe_name(name) in self._gauges
+
     def histogram(self, name: str, bounds=DEFAULT_BOUNDS) -> Histogram:
         instrument = self._histograms.get(name)
         if instrument is None:

@@ -206,6 +206,39 @@ class PipelineTelemetry:
                      elapsed_s * 1e6, {"path": path}))
             trace.instant(f"resume:{node}", "park", None)
 
+    def record_engine_frame(self, frame, node: str, stats_rows) -> None:
+        """A continuous-batching engine (LMGenerate `continuous: true`)
+        finished every row of a frame: per-slot spans (queue_wait /
+        prefill / decode_steps) reconstructed from the engine's
+        completion stats onto the frame trace, so Perfetto shows where
+        each request's lifetime went even though the engine ran it
+        interleaved with other frames' slots."""
+        if not self.enabled:
+            return
+        trace = frame.trace
+        if trace is None:
+            return
+        end = now_us()
+        for row, stats in enumerate(stats_rows):
+            total = float(stats.get("total_s", 0.0)) * 1e6
+            queue = float(stats.get("queue_wait_s", 0.0)) * 1e6
+            prefill = float(stats.get("prefill_s", 0.0)) * 1e6
+            start = end - total
+            suffix = f"[{row}]" if len(stats_rows) > 1 else ""
+            trace.events.append(
+                ("X", f"queue:{node}{suffix}", "queue", start, queue,
+                 None))
+            trace.events.append(
+                ("X", f"prefill:{node}{suffix}", "engine", start + queue,
+                 prefill, None))
+            trace.events.append(
+                ("X", f"decode_steps:{node}{suffix}", "engine",
+                 start + queue + prefill,
+                 max(total - queue - prefill, 0.0),
+                 {"decode_steps": stats.get("decode_steps"),
+                  "preemptions": stats.get("preemptions"),
+                  "tokens": stats.get("tokens")}))
+
     # -- fault tolerance ---------------------------------------------------
 
     def record_retry(self, frame, node: str, attempt: int,
@@ -338,7 +371,7 @@ class PipelineTelemetry:
         remote gateway admits/routes against these numbers (refreshed
         every metrics_interval) between the create/destroy-time share
         updates."""
-        return {
+        summary = {
             "load": self.pipeline.load(),
             "frames": self._frames_total.value,
             "dropped": self._frames_dropped.value,
@@ -352,6 +385,31 @@ class PipelineTelemetry:
             "retries": self.registry.counter("pipeline.retries").value,
             "dead_letters": self.registry.counter(
                 "pipeline.dead_letters").value,
+        }
+        decode = self.decode_summary()
+        if decode is not None:
+            summary["decode"] = decode
+        return summary
+
+    def decode_summary(self) -> dict | None:
+        """Continuous-batching engine scalars (decode/ gauges +
+        counters) for the EC share, so slot occupancy is visible PER
+        REPLICA on the dashboard services page and to the gateway's
+        ECConsumer mirrors -- not only on the live-metrics page.  None
+        when no engine has registered (the common non-LLM pipeline)."""
+        if not self.registry.has_gauge("decode.active_slots"):
+            return None
+        return {
+            "active_slots": self.registry.gauge(
+                "decode.active_slots").value,
+            "free_blocks": self.registry.gauge(
+                "decode.free_blocks").value,
+            "waiting": self.registry.gauge("decode.waiting").value,
+            "admitted": self.registry.counter("decode.admitted").value,
+            "completed": self.registry.counter("decode.completed").value,
+            "preempted": self.registry.counter("decode.preempted").value,
+            "deferred": self.registry.counter(
+                "decode.deferred_admissions").value,
         }
 
     def _publish_snapshot(self) -> None:

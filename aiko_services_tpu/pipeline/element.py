@@ -200,6 +200,15 @@ class PipelineElement(Actor):
         """
         return None
 
+    def engine_managed(self, stream: Stream) -> bool:
+        """True when the element runs its OWN batching engine for this
+        stream (e.g. LMGenerate's `continuous: true` slot-based decode
+        engine): the micro-batch scheduler must hand it frames
+        one-by-one -- the engine admits them into a running device
+        loop at prefill boundaries, which strictly dominates
+        coalescing whole frames.  Default False (scheduler-managed)."""
+        return False
+
     def eval_kernel(self):
         """Optional abstract-interpretation hook for the static
         analyzer (analyze/shape_eval.py): return `(kernel, state_fn)`

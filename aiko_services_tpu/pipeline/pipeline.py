@@ -1128,6 +1128,14 @@ class Pipeline(Actor):
         of it, never for more traffic."""
         if isinstance(element, AsyncHostElement):
             return False  # async elements manage their own parking
+        if element.engine_managed(stream):
+            # the element runs its OWN batching engine (LMGenerate
+            # `continuous: true`): frames must reach process_frame
+            # one-by-one so the engine can admit them into the running
+            # decode loop at prefill boundaries -- holding them in a
+            # coalesced group would reintroduce exactly the closed-batch
+            # convoy the engine exists to remove
+            return False
         try:
             micro = int(element.get_parameter("micro_batch", 1, stream) or 1)
         except (TypeError, ValueError):

@@ -41,12 +41,22 @@ class ProcessManager:
         return command
 
     def spawn(self, process_id, command: str, arguments=(),
-              use_interpreter: bool = True):
+              use_interpreter: bool = True,
+              start_new_session: bool = False,
+              stdout=None, stderr=None):
+        """`start_new_session` detaches the child from the caller's
+        terminal session (its own setsid), so closing the terminal
+        does not SIGHUP it -- what `aiko system start` needs for a
+        deployment that outlives the shell.  Detached children should
+        also get their own `stdout`/`stderr` (a log file): inheriting
+        the caller's keeps any pipe on it open forever."""
         command_path = self.resolve_command(command)
         argv = ([sys.executable, command_path] if use_interpreter
                 else [command_path])
         argv += [str(argument) for argument in arguments]
-        child = subprocess.Popen(argv)
+        child = subprocess.Popen(argv,
+                                 start_new_session=start_new_session,
+                                 stdout=stdout, stderr=stderr)
         with self._lock:
             self.processes[process_id] = {
                 "process": child, "command": command_path}

@@ -1270,6 +1270,201 @@ def bench_router(peak, replicas_n: int):
     }
 
 
+# -- config 6b: continuous batching (decode/ engine) -------------------------
+
+def bench_continuous(peak):
+    """`continuous` config: the slot-based decode engine (decode/) vs
+    the closed-batch generate() path under the SAME open-loop LLM
+    traffic -- seeded ragged prompts/completion lengths arriving at 2x
+    the engine's measured decode capacity.  The closed arm is the
+    STRONGEST closed-batch server this repo can build (one warmed
+    executable: fixed batch arity = `decode_slots` via zero-filler
+    rows, one prompt bucket, fixed decode length), so the gap is the
+    convoy/admission cost alone, not a compile artifact.  Published
+    per arm: sustained goodput (useful tokens/sec until the backlog
+    drains), TTFT p50/p99 (arrival -> first token), and -- continuous
+    only -- mean/peak slot occupancy plus the compile counter across
+    the measured window (must be 0: the zero-recompile guarantee)."""
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aiko_services_tpu.decode import DecodeEngine
+    from aiko_services_tpu.models import (
+        count_params, generate_stream, init_params,
+        transformer_flops_per_token)
+    from aiko_services_tpu.models.configs import LLAMA32_1B, LM_TOY
+    from aiko_services_tpu.utils.padding import bucket_length
+
+    config = LM_TOY if SMOKE else LLAMA32_1B
+    name = "lm_toy" if SMOKE else "llama32_1b"
+    slots = 4 if SMOKE else 8
+    block = 8 if SMOKE else 32
+    requests_n = 24 if SMOKE else 96
+    prompt_lo, prompt_hi = (4, 16) if SMOKE else (32, 128)
+    new_lo, new_hi = (4, 24) if SMOKE else (16, 96)
+    params = init_params(config, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+
+    rng = np.random.default_rng(11)
+    workload = [
+        (rng.integers(1, config.vocab_size,
+                      size=int(rng.integers(prompt_lo, prompt_hi + 1)))
+         .astype(np.int32),
+         int(rng.integers(new_lo, new_hi + 1)))
+        for _ in range(requests_n)]
+    mean_tokens = float(np.mean([new for _, new in workload]))
+    prompt_bucket = bucket_length(prompt_hi, minimum=block)
+    max_context = (-(-(prompt_bucket + new_hi) // block)) * block
+
+    engine = DecodeEngine(params, config, decode_slots=slots,
+                          kv_block_size=block, max_context=max_context)
+    # engine warmup: one prompt per reachable prefill bucket + the
+    # decode step, then a capacity probe with every slot busy
+    length = block
+    index = 0
+    while length <= prompt_bucket:
+        engine.submit(("warm", index), np.ones((length,), np.int32), 2)
+        length, index = length * 2, index + 1
+    while engine.has_work():
+        engine.step()
+    probe_steps = 8 if SMOKE else 32
+    for index in range(slots):
+        engine.submit(("probe", index),
+                      np.ones((prompt_lo,), np.int32), probe_steps + 2)
+    engine.step()  # admissions + first step outside the timed region
+    probe_start = time.perf_counter()
+    steps = 0
+    while engine.has_work():
+        steps += engine.step().active
+    capacity_tok_s = steps / max(time.perf_counter() - probe_start, 1e-9)
+    offered_req_s = 2.0 * capacity_tok_s / mean_tokens
+    arrivals = np.cumsum(rng.exponential(1.0 / offered_req_s,
+                                         size=requests_n))
+
+    # -- continuous arm ----------------------------------------------------
+    compiles_before = engine.compile_count
+    ttft = {}
+    occupancy = []
+    tokens_done = 0
+    next_index = 0
+    start = time.perf_counter()
+    while next_index < requests_n or engine.has_work():
+        now = time.perf_counter() - start
+        while (next_index < requests_n
+               and arrivals[next_index] <= now):
+            prompt, max_new = workload[next_index]
+            engine.submit(next_index, prompt, max_new)
+            next_index += 1
+        if not engine.has_work():
+            time.sleep(min(arrivals[next_index] - now, 0.01))
+            continue
+        report = engine.step()
+        occupancy.append(report.active / slots)
+        for request_id, offset, _ in report.emitted:
+            if offset == 0:
+                ttft[request_id] = (time.perf_counter() - start
+                                    - arrivals[request_id])
+        for completion in report.completions:
+            tokens_done += completion.stats["tokens"]
+    continuous_elapsed = time.perf_counter() - start
+    continuous = {
+        "goodput_tok_s": round(tokens_done / continuous_elapsed, 1),
+        "ttft_p50_ms": round(float(np.percentile(
+            list(ttft.values()), 50)) * 1000, 1),
+        "ttft_p99_ms": round(float(np.percentile(
+            list(ttft.values()), 99)) * 1000, 1),
+        "slot_occupancy_mean": round(float(np.mean(occupancy)), 3),
+        "slot_occupancy_peak": round(float(np.max(occupancy)), 3),
+        "preempted": engine.counters["preempted"],
+        "deferred_admissions": engine.counters["deferred_admissions"],
+        "compiles_in_window": engine.compile_count - compiles_before,
+    }
+
+    # -- closed-batch arm --------------------------------------------------
+    # one executable: batch always `slots` (zero-filler rows), prompts
+    # padded to ONE bucket, decode length fixed at new_hi -- a member's
+    # useful tokens stop at its own max_new, the rest of the batch's
+    # steps are the convoy cost
+    chunk = 4
+    warm_prompt = jnp.ones((slots, prompt_bucket), jnp.int32)
+    for _ in generate_stream(params, config, warm_prompt, new_hi,
+                             chunk=chunk):
+        pass
+    waiting = deque()
+    closed_ttft = {}
+    tokens_done = 0
+    batches = 0
+    fill = []
+    next_index = 0
+    start = time.perf_counter()
+    while next_index < requests_n or waiting:
+        now = time.perf_counter() - start
+        while (next_index < requests_n
+               and arrivals[next_index] <= now):
+            waiting.append(next_index)
+            next_index += 1
+        if not waiting:
+            time.sleep(min(arrivals[next_index] - now, 0.01))
+            continue
+        members = [waiting.popleft()
+                   for _ in range(min(slots, len(waiting)))]
+        prompts = np.ones((slots, prompt_bucket), np.int32)
+        for row, member in enumerate(members):
+            prompt = workload[member][0]
+            prompts[row, prompt_bucket - prompt.size:] = prompt  # left-pad
+        first_block_at = None
+        for _, block_tokens in generate_stream(
+                params, config, jnp.asarray(prompts), new_hi,
+                chunk=chunk):
+            if first_block_at is None:
+                np.asarray(block_tokens)  # force the prefill complete
+                first_block_at = time.perf_counter() - start
+        for member in members:
+            closed_ttft[member] = first_block_at - arrivals[member]
+            tokens_done += workload[member][1]  # useful tokens only
+        batches += 1
+        fill.append(len(members) / slots)
+    closed_elapsed = time.perf_counter() - start
+    closed = {
+        "goodput_tok_s": round(tokens_done / closed_elapsed, 1),
+        "ttft_p50_ms": round(float(np.percentile(
+            list(closed_ttft.values()), 50)) * 1000, 1),
+        "ttft_p99_ms": round(float(np.percentile(
+            list(closed_ttft.values()), 99)) * 1000, 1),
+        "batches": batches,
+        "batch_fill_mean": round(float(np.mean(fill)), 3),
+    }
+
+    decode_flops = transformer_flops_per_token(config, prompt_hi)
+    return {
+        "model": f"{name} ({n_params / 1e6:.0f}M params)",
+        "decode_slots": slots,
+        "kv_block_size": block,
+        "kv_blocks": engine.blocks.capacity,
+        "max_context": engine.max_context,
+        "requests": requests_n,
+        "prompt_len": f"uniform {prompt_lo}..{prompt_hi}",
+        "max_new": f"uniform {new_lo}..{new_hi}",
+        "arrival": ("seeded exponential, open-loop at 2x measured "
+                    "decode capacity"),
+        "offered_req_s": round(offered_req_s, 2),
+        "capacity_tok_s": round(capacity_tok_s, 1),
+        "continuous": continuous,
+        "closed_batch": closed,
+        "goodput_speedup": round(
+            continuous["goodput_tok_s"]
+            / max(closed["goodput_tok_s"], 1e-9), 2),
+        "ttft_p99_speedup": round(
+            closed["ttft_p99_ms"]
+            / max(continuous["ttft_p99_ms"], 1e-9), 2),
+        "decode_mfu": _mfu(continuous["goodput_tok_s"] * decode_flops,
+                           peak),
+    }
+
+
 # -- config 7: TTS -----------------------------------------------------------
 
 def _tts_definition(phrase, batch, count):
@@ -1494,7 +1689,8 @@ def main() -> None:
 
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
-                       "longcontext,serving,latency,tts,pipeline")
+                       "longcontext,serving,continuous,latency,tts,"
+                       "pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -1514,6 +1710,8 @@ def main() -> None:
         configs["longcontext"] = bench_longcontext(peak)
     if "serving" in wanted:
         configs["serving"] = bench_serving(peak)
+    if "continuous" in wanted:
+        configs["continuous"] = bench_continuous(peak)
     if router_replicas is not None or "router" in wanted:
         configs["router"] = bench_router(peak, router_replicas or 2)
     if "latency" in wanted:

@@ -1,0 +1,94 @@
+# Tutorial: the minimal actor.
+#
+# The smallest end-to-end aiko_services_tpu program -- one CUSTOM
+# pipeline element in a two-element graph, one stream, one frame,
+# one response.  No accelerator, no external broker, no model weights:
+# everything runs in-process on the loopback transport.
+#
+#   python examples/tutorial_minimal_actor.py
+#
+# Concepts, in the order they appear:
+#
+#   1. ELEMENT  -- a PipelineElement subclass.  `process_frame(stream,
+#      **inputs)` receives the frame's named inputs and returns
+#      (StreamEvent.OKAY, {named outputs}).  Elements are ACTORS: all
+#      calls arrive through one mailbox, so no locking is ever needed.
+#   2. DEFINITION -- the JSON-shaped dict naming the graph topology and
+#      each element's ports, parameters, and deploy target.  The same
+#      dict could live in a .json file (`aiko pipeline <file>`), and
+#      `aiko lint` statically checks it either way.
+#   3. STREAM / FRAME -- a stream is a session with per-stream
+#      parameters; each frame carries a dict of named values through
+#      the graph.  `queue_response` delivers the leaf outputs back.
+#
+# Where to go next: parameters + `get_parameter` precedence (stream >
+# element > pipeline) below; `ComputeElement` for jitted device
+# kernels; `micro_batch` / `continuous` for batching (README
+# "Continuous batching"); examples/pipeline_*.json for real graphs.
+
+from __future__ import annotations
+
+import pathlib
+import queue
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from aiko_services_tpu.pipeline import (PipelineElement, StreamEvent,
+                                        create_pipeline)
+from aiko_services_tpu.runtime import Process
+
+
+class Shout(PipelineElement):
+    """text in -> the same text, LOUDER.  The whole element contract is
+    this one method; start_stream/stop_stream/frame generators are
+    opt-in extras."""
+
+    def process_frame(self, stream, text):
+        suffix = str(self.get_parameter("suffix", "!", stream))
+        texts = [text] if isinstance(text, str) else list(text)
+        shouted = [str(part).upper() + suffix for part in texts]
+        return StreamEvent.OKAY, {"text": shouted}
+
+
+DEFINITION = {
+    "name": "tutorial",
+    # one graph expression: source feeds shout
+    "graph": ["(source (shout))"],
+    "elements": [
+        {"name": "source",
+         "output": [{"name": "text", "type": "str"}],
+         # TextSource emits one frame per data_sources item
+         "parameters": {"data_sources": ["hello, actor"]},
+         "deploy": {"local": {"module": "aiko_services_tpu.elements",
+                              "class_name": "TextSource"}}},
+        {"name": "shout",
+         "input": [{"name": "text", "type": "str"}],
+         "output": [{"name": "text", "type": "str"}],
+         # module "__main__" resolves to THIS file when run directly;
+         # real deployments name an importable module instead
+         "deploy": {"local": {"module": __name__,
+                              "class_name": "Shout"}}},
+    ],
+}
+
+
+def main() -> list:
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, DEFINITION)
+    process.run(in_thread=True)
+
+    responses = queue.Queue()
+    pipeline.create_stream("tutorial_stream", queue_response=responses,
+                           parameters={"suffix": "!!"})
+    # the source element generates the frame; we just collect the leaf
+    stream, frame, outputs = responses.get(timeout=60)
+    print(f"stream {stream.stream_id!r} frame {frame.frame_id}: "
+          f"{outputs['text']}")
+
+    process.terminate()
+    return outputs["text"]
+
+
+if __name__ == "__main__":
+    main()

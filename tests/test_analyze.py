@@ -293,6 +293,50 @@ class TestPolicyGrammars:
         problems = POLICY_GRAMMAR.check("max_inflght=4", "AIKO403")
         assert problems and problems[0][0] == "AIKO404"
 
+    def test_decode_parameters_check(self):
+        from aiko_services_tpu.analyze.policies import (
+            check_decode_parameters)
+        # valid continuous-batching parameter set: clean
+        assert check_decode_parameters({
+            "continuous": True, "decode_slots": 4, "kv_block_size": 16,
+            "kv_blocks": 64, "max_new_tokens": 32}) == []
+        # type/bounds violations carry AIKO405
+        problems = check_decode_parameters({"decode_slots": 0})
+        assert problems and problems[0][0] == "AIKO405"
+        problems = check_decode_parameters({"kv_block_size": "wide"})
+        assert problems and problems[0][0] == "AIKO405"
+        problems = check_decode_parameters({"kv_blocks": 1})
+        assert problems and problems[0][0] == "AIKO405"
+        # cross-field: a pool that cannot hold ONE completion
+        problems = check_decode_parameters({
+            "continuous": True, "kv_blocks": 2, "kv_block_size": 4,
+            "max_new_tokens": 32})
+        assert problems and "ever be admitted" in problems[0][1]
+        problems = check_decode_parameters({
+            "continuous": True, "max_context": 8,
+            "max_new_tokens": 32})
+        assert problems and problems[0][0] == "AIKO405"
+        # the engine rounds max_context UP to a block multiple; the
+        # lint judges the rounded capacity (20 -> 32 holds 25 + 1)
+        assert check_decode_parameters({
+            "continuous": True, "kv_block_size": 16, "max_context": 20,
+            "max_new_tokens": 25}) == []
+
+    def test_decode_parameters_flow_through_policy_pass(self):
+        from aiko_services_tpu.analyze import analyze_definition
+        definition = {
+            "name": "bad_decode", "graph": ["(source)"],
+            "elements": [
+                {"name": "source",
+                 "output": [{"name": "text", "type": "str"}],
+                 "parameters": {"data_sources": ["x"],
+                                "continuous": True, "decode_slots": -1},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "TextSource"}}}]}
+        report = analyze_definition(definition, passes=["policy"])
+        assert [d.code for d in report.findings] == ["AIKO405"]
+
     def test_fault_injector_still_parses_through_core(self):
         from aiko_services_tpu.faults import create_injector
         injector = create_injector(

@@ -40,6 +40,17 @@ def test_pipeline_text_example_runs():
     process.terminate()
 
 
+def test_tutorial_minimal_actor_runs():
+    """The README's entry-point tutorial must keep working verbatim."""
+    import sys
+    sys.path.insert(0, str(EXAMPLES))
+    try:
+        import tutorial_minimal_actor
+        assert tutorial_minimal_actor.main() == ["HELLO, ACTOR!!"]
+    finally:
+        sys.path.remove(str(EXAMPLES))
+
+
 def test_pipeline_compute_example_runs():
     process = Process(transport_kind="loopback")
     pipeline = create_pipeline(process,

@@ -1,0 +1,390 @@
+# Fake-backend contract tests for the hard-gated media elements
+# (gstreamer_io.py, webcam_io.py).  TPU pods ship neither PyGObject nor
+# a camera, so these suites inject STUB backends into sys.modules and
+# pin the element contracts that real deployments rely on: the frame
+# schema ((3, H, W) float32 RGB in [0, 1]), the gating diagnostics when
+# the backend is absent, error-policy behavior on bad ticks, and
+# backend resource cleanup at stream stop.
+
+import queue
+import types
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.transport import reset_brokers
+
+from helpers import wait_for
+
+ELEMENTS = "aiko_services_tpu.elements"
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+def local(class_name):
+    return {"local": {"module": ELEMENTS, "class_name": class_name}}
+
+
+def run_source(definition, count, timeout=60, destroy_after=None):
+    """Drive a one-source pipeline; returns (responses list, pipeline,
+    process) with the process still running (caller terminates)."""
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, definition)
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    pipeline.create_stream("s", queue_response=responses, grace_time=60)
+    results = [responses.get(timeout=timeout) for _ in range(count)]
+    if destroy_after:
+        pipeline.destroy_stream("s")
+    return results, pipeline, process
+
+
+# -- fake GStreamer backend --------------------------------------------------
+
+class FakeMapped:
+    def __init__(self, data):
+        self.data = data
+
+
+class FakeGstBuffer:
+    def __init__(self, data, map_ok=True):
+        self._data = data
+        self._map_ok = map_ok
+        self.unmapped = False
+        self.pts = None
+        self.duration = None
+
+    def map(self, _flags):
+        if not self._map_ok:
+            return False, None
+        return True, FakeMapped(self._data)
+
+    def unmap(self, _mapped):
+        self.unmapped = True
+
+
+class FakeCaps:
+    def __init__(self, width, height):
+        self._values = {"width": width, "height": height}
+
+    def get_structure(self, _index):
+        return self
+
+    def get_value(self, key):
+        return self._values[key]
+
+
+class FakeSample:
+    def __init__(self, array, map_ok=True):
+        height, width = array.shape[:2]
+        self.buffer = FakeGstBuffer(array.tobytes(), map_ok=map_ok)
+        self.caps = FakeCaps(width, height)
+
+    def get_buffer(self):
+        return self.buffer
+
+    def get_caps(self):
+        return self.caps
+
+
+class FakeGstElement:
+    """appsink / appsrc stand-in: pull-sample pops the scripted sample
+    list; push-buffer / end-of-stream record what the writer sent."""
+
+    def __init__(self, samples=None):
+        self.samples = list(samples or [])
+        self.pushed = []
+        self.eos = False
+
+    def emit(self, signal, *arguments):
+        if signal == "pull-sample":
+            return self.samples.pop(0) if self.samples else None
+        if signal == "push-buffer":
+            self.pushed.append(arguments[0])
+            return None
+        if signal == "end-of-stream":
+            self.eos = True
+            return None
+        raise AssertionError(f"unexpected Gst signal {signal!r}")
+
+
+class FakeGstPipeline:
+    def __init__(self, description, element):
+        self.description = description
+        self.element = element
+        self.states = []
+
+    def get_by_name(self, _name):
+        return self.element
+
+    def set_state(self, state):
+        self.states.append(state)
+
+
+def make_fake_gst(samples=None):
+    """A stub `gi`/`gi.repository.Gst` pair implementing exactly the
+    surface gstreamer_io.py touches."""
+    gst = types.SimpleNamespace()
+    gst.launched = []
+    element = FakeGstElement(samples)
+
+    class State:
+        PLAYING = "PLAYING"
+        NULL = "NULL"
+
+    class MapFlags:
+        READ = "READ"
+
+    class Buffer:
+        @staticmethod
+        def new_wrapped(data):
+            return FakeGstBuffer(data)
+
+    def parse_launch(description):
+        fake = FakeGstPipeline(description, element)
+        gst.launched.append(fake)
+        return fake
+
+    gst.init = lambda _argv: None
+    gst.parse_launch = parse_launch
+    gst.State = State
+    gst.MapFlags = MapFlags
+    gst.Buffer = Buffer
+    gst.SECOND = 10 ** 9
+    gst.element = element
+
+    gi = types.ModuleType("gi")
+    gi.require_version = lambda _name, _version: None
+    repository = types.ModuleType("gi.repository")
+    repository.Gst = gst
+    gi.repository = repository
+    return gi, repository, gst
+
+
+@pytest.fixture
+def fake_gst(monkeypatch):
+    def install(samples=None):
+        gi, repository, gst = make_fake_gst(samples)
+        monkeypatch.setitem(__import__("sys").modules, "gi", gi)
+        monkeypatch.setitem(__import__("sys").modules, "gi.repository",
+                            repository)
+        return gst
+    return install
+
+
+class TestVideoStreamReaderContract:
+    def _definition(self, parameters=None):
+        return {
+            "name": "gst_read", "graph": ["(reader)"],
+            "elements": [
+                {"name": "reader", "output": [{"name": "image"}],
+                 "parameters": {"data_sources": ["rtsp://fake/stream"],
+                                **(parameters or {})},
+                 "deploy": local("VideoStreamReader")}]}
+
+    def test_frame_schema_and_url_wiring(self, fake_gst):
+        rgb = (np.arange(2 * 3 * 3) % 255).astype(np.uint8).reshape(
+            2, 3, 3)
+        gst = fake_gst(samples=[FakeSample(rgb), FakeSample(rgb)])
+        results, _, process = run_source(self._definition(), count=2)
+        for _, _, outputs in results:
+            image = outputs["image"]
+            # the contract: (3, H, W) float32 RGB in [0, 1]
+            assert image.shape == (3, 2, 3)
+            assert image.dtype == np.float32
+            np.testing.assert_allclose(
+                image, rgb.astype(np.float32).transpose(2, 0, 1) / 255.0)
+        # the appsink url reached the launch description
+        assert "uri=rtsp://fake/stream" in gst.launched[0].description
+        assert gst.launched[0].states[0] == "PLAYING"
+        # every mapped buffer was unmapped (no leaked Gst buffers)
+        assert all(sample.buffer.unmapped
+                   for sample in gst.element.samples or [])
+        process.terminate()
+
+    def test_stream_end_stops_and_nulls_pipeline(self, fake_gst):
+        rgb = np.zeros((2, 2, 3), np.uint8)
+        gst = fake_gst(samples=[FakeSample(rgb)])  # then None -> STOP
+        results, pipeline, process = run_source(self._definition(),
+                                                count=1)
+        assert results[0][2]["image"].shape == (3, 2, 2)
+        # pull-sample returning None ends the stream; stop_stream must
+        # drop the Gst pipeline to State.NULL
+        wait_for(lambda: "NULL" in gst.launched[0].states, timeout=30)
+        wait_for(lambda: not pipeline.streams, timeout=30)
+        process.terminate()
+
+    def test_bad_tick_with_drop_frame_keeps_stream(self, fake_gst):
+        """A buffer whose map() fails is ONE bad tick: under `on_error:
+        drop_frame` the reader drops it and keeps serving (PR-3
+        generator contract), instead of destroying the stream."""
+        rgb = np.full((2, 2, 3), 7, np.uint8)
+        fake_gst(samples=[FakeSample(rgb), FakeSample(rgb, map_ok=False),
+                          FakeSample(rgb)])
+        results, _, process = run_source(
+            self._definition({"on_error": "drop_frame"}), count=2)
+        assert len(results) == 2  # 3 ticks, 1 dropped, stream alive
+        for _, _, outputs in results:
+            assert outputs["image"].shape == (3, 2, 2)
+        process.terminate()
+
+    def test_missing_backend_is_a_clear_error(self, monkeypatch):
+        import sys
+        monkeypatch.setitem(sys.modules, "gi", None)  # import -> error
+        from aiko_services_tpu.elements.gstreamer_io import gst_available
+        assert not gst_available()
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, self._definition())
+        process.run(in_thread=True)
+        pipeline.create_stream("s", grace_time=30)
+        # start_stream ERRORs with the gating diagnostic: no stream
+        wait_for(lambda: not pipeline.streams, timeout=30)
+        process.terminate()
+
+
+class TestVideoStreamWriterContract:
+    def _definition(self):
+        return {
+            "name": "gst_write", "graph": ["(camera (writer))"],
+            "elements": [
+                {"name": "camera", "output": [{"name": "image"}],
+                 "parameters": {"data_sources": [[3, 4, 4], [3, 4, 4]]},
+                 "deploy": local("ImageSource")},
+                {"name": "writer", "input": [{"name": "image"}],
+                 "output": [{"name": "image"}],
+                 "parameters": {"stream_url": "rtmp://fake/out",
+                                "frame_rate": 5},
+                 "deploy": local("VideoStreamWriter")}]}
+
+    def test_pushes_uint8_buffers_with_timestamps(self, fake_gst):
+        gst = fake_gst()
+        results, pipeline, process = run_source(self._definition(),
+                                                count=2)
+        assert len(gst.launched) == 1
+        launch = gst.launched[0]
+        assert "location=rtmp://fake/out" in launch.description
+        assert "width=4,height=4" in launch.description
+        assert len(gst.element.pushed) == 2
+        for index, buffer in enumerate(gst.element.pushed):
+            assert len(buffer._data) == 4 * 4 * 3  # HWC uint8 bytes
+            assert buffer.pts == index * gst.SECOND // 5
+            assert buffer.duration == gst.SECOND // 5
+        # the writer passes the image through for downstream consumers
+        for _, _, outputs in results:
+            assert np.asarray(outputs["image"]).shape[-2:] == (4, 4)
+        pipeline.destroy_stream("s")
+        wait_for(lambda: gst.element.eos, timeout=30)
+        assert "NULL" in launch.states
+        process.terminate()
+
+    def test_missing_backend_is_a_clear_error(self, monkeypatch):
+        import sys
+        monkeypatch.setitem(sys.modules, "gi", None)
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, self._definition())
+        process.run(in_thread=True)
+        pipeline.create_stream("s", grace_time=30)
+        wait_for(lambda: not pipeline.streams, timeout=30)
+        process.terminate()
+
+
+# -- fake cv2 backend --------------------------------------------------------
+
+class FakeCapture:
+    def __init__(self, device, frames, opened=True):
+        self.device = device
+        self.frames = list(frames)
+        self.opened = opened
+        self.released = False
+
+    def isOpened(self):
+        return self.opened
+
+    def read(self):
+        if not self.frames:
+            return False, None
+        return True, self.frames.pop(0)
+
+    def release(self):
+        self.released = True
+
+
+def make_fake_cv2(frames, opened=True):
+    cv2 = types.ModuleType("cv2")
+    cv2.captures = []
+
+    def video_capture(device):
+        capture = FakeCapture(device, frames, opened=opened)
+        cv2.captures.append(capture)
+        return capture
+
+    cv2.VideoCapture = video_capture
+    return cv2
+
+
+class TestVideoReadWebcamContract:
+    def _definition(self, parameters=None):
+        return {
+            "name": "webcam", "graph": ["(camera)"],
+            "elements": [
+                {"name": "camera", "output": [{"name": "image"}],
+                 "parameters": {"data_sources": [0],
+                                **(parameters or {})},
+                 "deploy": local("VideoReadWebcam")}]}
+
+    def test_frame_schema_bgr_to_rgb(self, monkeypatch):
+        import sys
+        # BGR frame with distinct channels proves the reversal
+        bgr = np.zeros((2, 3, 3), np.uint8)
+        bgr[:, :, 0] = 255  # blue plane (cv2 order)
+        cv2 = make_fake_cv2([bgr.copy(), bgr.copy()])
+        monkeypatch.setitem(sys.modules, "cv2", cv2)
+        results, _, process = run_source(self._definition(), count=2)
+        for _, _, outputs in results:
+            image = outputs["image"]
+            assert image.shape == (3, 2, 3)
+            assert image.dtype == np.float32
+            assert (image[2] == 1.0).all()  # blue landed in RGB slot 2
+            assert (image[:2] == 0.0).all()
+        process.terminate()
+
+    def test_device_string_coerced_and_released_on_end(self, monkeypatch):
+        import sys
+        frame = np.ones((2, 2, 3), np.uint8)
+        cv2 = make_fake_cv2([frame])
+        monkeypatch.setitem(sys.modules, "cv2", cv2)
+        results, pipeline, process = run_source(
+            self._definition({"data_sources": ["7"]}), count=1)
+        assert cv2.captures[0].device == 7  # "7" -> int index
+        # read() exhaustion STOPs the stream and releases the device
+        wait_for(lambda: cv2.captures[0].released, timeout=30)
+        wait_for(lambda: not pipeline.streams, timeout=30)
+        process.terminate()
+
+    def test_unopenable_device_is_a_clear_error(self, monkeypatch):
+        import sys
+        cv2 = make_fake_cv2([], opened=False)
+        monkeypatch.setitem(sys.modules, "cv2", cv2)
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, self._definition())
+        process.run(in_thread=True)
+        pipeline.create_stream("s", grace_time=30)
+        wait_for(lambda: not pipeline.streams, timeout=30)
+        process.terminate()
+
+    def test_missing_cv2_is_a_clear_error(self, monkeypatch):
+        import sys
+        monkeypatch.setitem(sys.modules, "cv2", None)
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, self._definition())
+        process.run(in_thread=True)
+        pipeline.create_stream("s", grace_time=30)
+        wait_for(lambda: not pipeline.streams, timeout=30)
+        process.terminate()

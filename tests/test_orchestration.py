@@ -2,6 +2,7 @@
 # request idioms, recorder, lifecycle manager/client -- hermetic over the
 # loopback broker.
 
+import os
 import sys
 import time
 
@@ -89,6 +90,93 @@ class TestProcessManager:
     def test_resolve_command_module(self):
         path = ProcessManager.resolve_command("json")
         assert path.endswith("__init__.py")
+
+
+class TestSystemBootstrap:
+    """`aiko system start|stop`: the one-command local deployment
+    (registrar + named pipeline as detached children, pids recorded in
+    a state file the stop command consumes)."""
+
+    def _definition(self, tmp_path):
+        import json
+        path = tmp_path / "tiny.json"
+        path.write_text(json.dumps({
+            "name": "tiny", "graph": ["(source)"],
+            "elements": [
+                {"name": "source",
+                 "output": [{"name": "text", "type": "str"}],
+                 "parameters": {"data_sources": ["x"]},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "TextSource"}}}]}))
+        return path
+
+    def test_start_then_stop(self, tmp_path):
+        from click.testing import CliRunner
+        from aiko_services_tpu.cli import main as cli_main
+        from aiko_services_tpu.cli import _pid_alive, _system_state
+
+        state_file = tmp_path / "system.json"
+        runner = CliRunner()
+        result = runner.invoke(cli_main, [
+            "system", "start", str(self._definition(tmp_path)),
+            "--name", "boot_pipe", "--transport", "loopback",
+            "--no-dashboard", "--state-file", str(state_file)])
+        assert result.exit_code == 0, result.output
+        state = _system_state(str(state_file))
+        pids = state["pids"]
+        assert set(pids) == {"registrar", "pipeline:boot_pipe"}
+        assert all(_pid_alive(pid) for pid in pids.values())
+
+        # double-start refuses while the recorded pids are alive
+        again = runner.invoke(cli_main, [
+            "system", "start", str(self._definition(tmp_path)),
+            "--no-dashboard", "--state-file", str(state_file)])
+        assert again.exit_code == 1
+
+        status = runner.invoke(cli_main, [
+            "system", "status", "--state-file", str(state_file)])
+        assert status.exit_code == 0 and "up" in status.output
+
+        result = runner.invoke(cli_main, [
+            "system", "stop", "--state-file", str(state_file)])
+        assert result.exit_code == 0, result.output
+        wait_for(lambda: not any(_pid_alive(pid)
+                                 for pid in pids.values()), timeout=15)
+        assert not state_file.exists()
+
+    def test_stop_without_state_is_an_error(self, tmp_path):
+        from click.testing import CliRunner
+        from aiko_services_tpu.cli import main as cli_main
+        runner = CliRunner()
+        result = runner.invoke(cli_main, [
+            "system", "stop", "--state-file",
+            str(tmp_path / "missing.json")])
+        assert result.exit_code == 1
+
+    @pytest.mark.skipif(not os.path.exists("/proc"),
+                        reason="pid identity check needs /proc; without "
+                               "it the fallback would SIGTERM this very "
+                               "test process")
+    def test_stop_refuses_recycled_pid(self, tmp_path):
+        """A stale state file whose pid now belongs to an UNRELATED
+        process (reboot/pid reuse) must not be signalled: this very
+        test process is alive but is not an `aiko_services_tpu`
+        child, so stop leaves it alone."""
+        import json
+        import os
+        from click.testing import CliRunner
+        from aiko_services_tpu.cli import main as cli_main
+
+        state_file = tmp_path / "system.json"
+        state_file.write_text(json.dumps(
+            {"pids": {"registrar": os.getpid()}}))
+        runner = CliRunner()
+        result = runner.invoke(cli_main, [
+            "system", "stop", "--state-file", str(state_file)])
+        assert result.exit_code == 0, result.output
+        assert "leaving it alone" in result.output
+        assert not state_file.exists()
 
 
 class TestStorage:
