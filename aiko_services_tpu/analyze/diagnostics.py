@@ -64,6 +64,7 @@ RULES = {
     "AIKO403": ("error", "invalid gateway admission-policy spec"),
     "AIKO404": ("error", "unknown directive in a policy grammar"),
     "AIKO405": ("error", "invalid continuous-batching decode parameter"),
+    "AIKO406": ("error", "invalid autoscale policy spec"),
 }
 
 

@@ -14,8 +14,9 @@ from .diagnostics import AnalysisReport, Diagnostic
 from .grammar import Field
 
 __all__ = ["run_policy_pass", "check_gateway_policy",
-           "check_faults_spec", "check_decode_parameters",
-           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS"]
+           "check_autoscale_policy", "check_faults_spec",
+           "check_decode_parameters", "FAULT_TOLERANCE_FIELDS",
+           "DECODE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -125,6 +126,22 @@ def check_gateway_policy(spec) -> list:
     return problems
 
 
+def check_autoscale_policy(spec) -> list:
+    """(code, message) problems in an elastic-fleet autoscale spec.
+    Same shape as check_gateway_policy: the per-directive grammar
+    check, then the REAL ScalePolicy.parse so cross-field constraints
+    (min <= max replicas, low_water < high_water) fail offline exactly
+    as they would when the gateway enables the autoscaler."""
+    from ..serve.autoscale import AUTOSCALE_GRAMMAR, ScalePolicy
+    problems = AUTOSCALE_GRAMMAR.check(spec, value_code="AIKO406")
+    if not problems:
+        try:
+            ScalePolicy.parse(spec)
+        except ValueError as error:
+            problems.append(("AIKO406", str(error)))
+    return problems
+
+
 def run_policy_pass(definition) -> AnalysisReport:
     report = AnalysisReport(passes_run=["policy"])
     name = definition.name
@@ -158,5 +175,9 @@ def run_policy_pass(definition) -> AnalysisReport:
     policy_spec = (definition.parameters or {}).get("gateway_policy")
     if policy_spec:
         for code, message in check_gateway_policy(policy_spec):
+            report.add(Diagnostic(code, message, definition=name))
+    autoscale_spec = (definition.parameters or {}).get("autoscale_policy")
+    if autoscale_spec:
+        for code, message in check_autoscale_policy(autoscale_spec):
             report.add(Diagnostic(code, message, definition=name))
     return report

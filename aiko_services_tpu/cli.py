@@ -42,13 +42,40 @@ def pipeline(definition: str, name: str | None, transport: str | None,
              stream_id: str | None, stream_parameters: str,
              frame_data: str | None, grace_time: float) -> None:
     """Create and run a pipeline from a JSON definition (reference
-    `aiko_pipeline create`, pipeline.py:1444-1528)."""
+    `aiko_pipeline create`, pipeline.py:1444-1528).
+
+    Elastic-fleet children honor two env knobs set by the replica
+    factory (serve/autoscale.py): AIKO_COMPILE_CACHE points JAX's
+    persistent compilation cache at the fleet's shared directory, and
+    AIKO_WARM_WEIGHTS names a descriptor file whose tensors are
+    fetched from a live sibling over the transfer plane instead of
+    re-running setup()."""
     import json
+    import os
 
     from .pipeline import create_pipeline
-    from .runtime import Process
+    from .runtime import Process, enable_compile_cache
+    enable_compile_cache()  # no-op unless AIKO_COMPILE_CACHE is set
     process = Process(transport_kind=transport)
     pipeline_instance = create_pipeline(process, definition, name=name)
+    warm_weights = os.environ.get("AIKO_WARM_WEIGHTS")
+    if warm_weights:
+        # a failed hand-off (expired transfer keys, drained sibling)
+        # downgrades to a COLD start -- setup() runs lazily as usual;
+        # dying here would turn a scale-up into a failed spawn
+        try:
+            with open(warm_weights) as handoff:
+                installed = pipeline_instance.import_weights(
+                    json.load(handoff))
+            click.echo(f"warm start: imported weights for {installed}")
+        except Exception as error:
+            click.echo(f"warm start failed ({error}); starting cold",
+                       err=True)
+        finally:
+            try:  # one-shot descriptor file from the replica factory
+                os.unlink(warm_weights)
+            except OSError:
+                pass
     if stream_id is not None:
         pipeline_instance.create_stream(
             stream_id, parameters=json.loads(stream_parameters),
@@ -411,14 +438,83 @@ def system_stop(state_file: str, timeout: float) -> None:
     click.echo("stopped")
 
 
+def _print_replica_pools(transport: str | None, wait: float) -> int:
+    """Discover serving gateways through the registrar and print each
+    one's replica pool (replica topic, state, load gauges, warm/cold)
+    from its EC share -- rendered by the SAME plugin the dashboard
+    uses, so the two views cannot drift.  Returns the number of
+    gateways found."""
+    import time
+    from types import SimpleNamespace
+
+    from .dashboard import _gateway_plugin
+    from .runtime import Process
+    from .runtime.service import ServiceFilter
+    from .runtime.share import ECConsumer, services_cache_create_singleton
+
+    process = Process(transport_kind=transport)
+    gateways: dict = {}
+
+    def handler(command, fields):
+        if command == "add":
+            gateways[fields.topic_path] = fields
+
+    cache = services_cache_create_singleton(process)
+    # protocols are full URLs ("github.com/.../protocol/gateway:0"):
+    # the pattern must match the whole string, not just the tail word
+    cache.add_handler(handler, ServiceFilter(protocol="*/gateway:*"))
+    process.run(in_thread=True)
+    try:
+        deadline = time.monotonic() + wait
+        while time.monotonic() < deadline and not gateways:
+            time.sleep(0.05)
+        if not gateways:
+            click.echo("pool: no gateway services discovered "
+                       f"(waited {wait}s)")
+            return 0
+        # snapshot: the discovery handler keeps appending from the
+        # message-pump thread, and a gateway arriving after this point
+        # simply waits for the next invocation
+        found = sorted(gateways.items())
+        shares = {topic_path: {} for topic_path, _ in found}
+        consumers = [ECConsumer(process, shares[topic_path], topic_path)
+                     for topic_path, _ in found]
+        # give the share mirrors until the deadline to fill in; the
+        # pool detail rides the periodic telemetry summary
+        while (time.monotonic() < deadline
+               and not all(shares.values())):
+            time.sleep(0.05)
+        for topic_path, fields in found:
+            click.echo(f"gateway {fields.name} ({topic_path})")
+            model = SimpleNamespace(selected_share=shares[topic_path])
+            for line in _gateway_plugin(model):
+                click.echo(f"  {line}")
+        for consumer in consumers:
+            consumer.terminate()
+        return len(found)
+    finally:
+        process.terminate()
+
+
 @system.command("status")
 @click.option("--state-file", default=DEFAULT_STATE_FILE)
-def system_status(state_file: str) -> None:
-    """Liveness of every recorded process."""
+@click.option("--pool/--no-pool", "show_pool", default=False,
+              help="Also discover serving gateways via the registrar "
+                   "and print each replica pool (state, load gauges, "
+                   "warm/cold)")
+@click.option("--transport", default=None,
+              help="Transport for --pool discovery (default: the "
+                   "start-time transport from the state file)")
+@click.option("--wait", default=3.0,
+              help="Seconds to wait for --pool discovery")
+def system_status(state_file: str, show_pool: bool,
+                  transport: str | None, wait: float) -> None:
+    """Liveness of every recorded process; --pool adds the serving
+    tier's replica pools."""
     import sys
     state = _system_state(state_file)
     pids = state.get("pids") or {}
-    if not pids:
+    if not pids and not show_pool:
         click.echo(f"nothing recorded in {state_file}")
         sys.exit(1)
     logs = state.get("logs") or {}
@@ -429,6 +525,8 @@ def system_status(state_file: str) -> None:
         suffix = f"  {logs[service_id]}" if service_id in logs else ""
         click.echo(f"{service_id:24} pid {pid:<8} "
                    f"{'up' if alive else 'DOWN'}{suffix}")
+    if show_pool:
+        _print_replica_pools(transport or state.get("transport"), wait)
     sys.exit(1 if down else 0)
 
 

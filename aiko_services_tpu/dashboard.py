@@ -90,6 +90,59 @@ def _pipeline_plugin(model: "DashboardModel") -> list:
 register_plugin("pipeline", _pipeline_plugin)
 
 
+def _gateway_plugin(model: "DashboardModel") -> list:
+    """Serving-gateway detail lines: admission/routing totals from the
+    telemetry summary plus a per-gateway `pool:` row (elastic fleet:
+    size, scale decisions, last time-to-healthy) and one line per
+    replica (state, load, warm/cold) -- the same view `aiko system
+    status` prints from the EC share."""
+    share = model.selected_share
+    lines = [f"replicas: {share.get('replica_count', '?')}   "
+             f"streams: {share.get('stream_count', '?')}   "
+             f"policy: {share.get('policy', '') or '(defaults)'}"]
+    metrics = share.get("metrics")
+    if not isinstance(metrics, dict):
+        lines.append("telemetry: (no summary yet -- disabled or first "
+                     "interval pending; press m for live metrics)")
+        return lines
+    lines.append(
+        f"admission: admitted {metrics.get('admitted', 0)}  "
+        f"shed {metrics.get('shed_frames', 0)}  "
+        f"routed {metrics.get('routed', 0)}  "
+        f"completed {metrics.get('completed', 0)}  "
+        f"parked {metrics.get('parked', 0)}  "
+        f"failovers {metrics.get('failovers', 0)}")
+    pool_line = (
+        f"pool: size {metrics.get('pool_size', 0)}  "
+        f"pending {metrics.get('pending_spawns', 0)}  "
+        f"scale_up {metrics.get('scale_ups', 0)}  "
+        f"scale_down {metrics.get('scale_downs', 0)}")
+    if "time_to_healthy_ms" in metrics:
+        pool_line += (f"  time_to_healthy "
+                      f"{metrics.get('time_to_healthy_ms')}ms")
+    lines.append(pool_line)
+    pool = metrics.get("pool")
+    if isinstance(pool, dict):
+        for name in sorted(pool):
+            replica = pool[name]
+            if not isinstance(replica, dict):
+                continue
+            # EC-share values may arrive as wire STRINGS ("True")
+            warm = str(replica.get("warm", False)).lower() in (
+                "true", "1")
+            lines.append(
+                f"  {name}: {replica.get('state', '?')}  "
+                f"{'warm' if warm else 'cold'}  "
+                f"inflight {replica.get('outstanding', 0)}/"
+                f"{replica.get('inflight', 0)}  "
+                f"queue {replica.get('queue_depth', 0)}  "
+                f"streams {replica.get('streams', 0)}")
+    return lines
+
+
+register_plugin("gateway", _gateway_plugin)
+
+
 def format_snapshot_lines(snapshot: dict, limit: int = 40) -> list:
     """Human-readable lines for one metrics snapshot: counters first
     (sorted), then histograms as count/mean/max milliseconds."""

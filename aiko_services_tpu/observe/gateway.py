@@ -43,6 +43,17 @@ class GatewayTelemetry:
         self.replicas = registry.gauge("gateway.replicas")
         self.parked = registry.gauge("gateway.parked")
         self.latency = registry.histogram("gateway.admit_latency_s")
+        # elastic fleet (serve/autoscale.py): pool occupancy, scale
+        # decisions, and the bring-up number the warm-start work
+        # optimizes -- spawn decision -> replica serving its first frame
+        self.pool_size = registry.gauge("gateway.pool_size")
+        self.scale_ups = registry.counter("gateway.scale_up")
+        self.scale_downs = registry.counter("gateway.scale_down")
+        self.time_to_healthy = registry.histogram(
+            "gateway.time_to_healthy_ms")
+        self.warm_spawns = registry.counter("gateway.spawns_warm")
+        self.cold_spawns = registry.counter("gateway.spawns_cold")
+        self.last_time_to_healthy_ms: float | None = None
         self._interval = interval
         self._timer = None
         if self.enabled and interval > 0:
@@ -64,12 +75,20 @@ class GatewayTelemetry:
             return
         self.registry.counter(f"gateway.routed:{replica_name}").inc()
 
+    def record_spawn(self, time_to_healthy_ms: float,
+                     warm: bool) -> None:
+        """One finished replica bring-up: decision -> healthy, labeled
+        warm (sibling hand-off + compile-cache) or cold."""
+        self.time_to_healthy.record(time_to_healthy_ms)
+        self.last_time_to_healthy_ms = round(time_to_healthy_ms, 2)
+        (self.warm_spawns if warm else self.cold_spawns).inc()
+
     def snapshot(self) -> dict:
         return self.registry.snapshot()
 
     def summary(self) -> dict:
         """Compact scalars for the EC share / dashboards."""
-        return {
+        summary = {
             "admitted": self.admitted.value,
             "shed_streams": self.shed_streams.value,
             "shed_frames": self.shed_frames.value,
@@ -81,7 +100,17 @@ class GatewayTelemetry:
             "replica_deaths": self.replica_deaths.value,
             "replicas": self.replicas.value,
             "parked": self.parked.value,
+            "pool_size": self.pool_size.value,
+            "scale_ups": self.scale_ups.value,
+            "scale_downs": self.scale_downs.value,
         }
+        if self.last_time_to_healthy_ms is not None:
+            summary["time_to_healthy_ms"] = self.last_time_to_healthy_ms
+        autoscaler = getattr(self.gateway, "autoscaler", None)
+        if autoscaler is not None:
+            summary["pool"] = self.gateway.pool_snapshot()
+            summary["pending_spawns"] = autoscaler.pending
+        return summary
 
     def _publish_snapshot(self) -> None:
         gateway = self.gateway

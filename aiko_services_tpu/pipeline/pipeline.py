@@ -2009,6 +2009,102 @@ class Pipeline(Actor):
                 stream.frame_id = frame_id
         return metadata
 
+    # -- live weight hand-off (elastic-fleet warm start) -------------------
+    #
+    # A freshly spawned replica re-running setup() re-initializes (or
+    # re-loads) every parameter the fleet already holds in HBM.  The
+    # transfer plane (pipeline/transfer.py) already moves bulk tensors
+    # process-to-process with the broker carrying only descriptors, so a
+    # live sibling can STREAM its params instead: export_weights()
+    # offers every ComputeElement state leaf and returns a
+    # JSON-serializable descriptor tree; the new replica's
+    # import_weights() fetches the leaves and installs them through the
+    # checkpoint-restore path (restore_state), so mesh placement and
+    # the no-double-allocation guarantee are the proven ones.
+
+    def export_weights(self) -> dict:
+        """Offer every ComputeElement's device state over the transfer
+        plane; returns {element_name: descriptor_tree} where each leaf
+        is a `{TENSOR_REF_KEY: descriptor}` marker.  Only elements
+        whose state ALREADY exists are exported: this runs on the
+        spawner's thread, and forcing a lazy setup() here would race
+        the sibling's own event loop mid-frame -- an element that has
+        never served simply comes up cold on the importer."""
+        import numpy as np
+        from .tpu_element import ComputeElement
+        from .transfer import TENSOR_REF_KEY, get_transfer_server
+        from ..observe.metrics import get_registry
+        import jax
+
+        server = get_transfer_server()
+        metrics = get_registry()
+        exported = {}
+        for name, element in self.elements.items():
+            if not isinstance(element, ComputeElement):
+                continue
+            if element.state is None:
+                continue
+
+            def offer(leaf):
+                array = np.asarray(leaf)
+                metrics.counter("warm_start.exported_bytes").inc(
+                    array.nbytes)
+                return {TENSOR_REF_KEY: server.offer(array)}
+
+            exported[name] = jax.tree_util.tree_map(offer, element.state)
+        metrics.counter("warm_start.exports").inc()
+        return exported
+
+    def import_weights(self, exported: dict) -> list:
+        """Fetch a sibling's export_weights() tree and install it:
+        returns the element names that received state.  Elements absent
+        from the tree (or unknown here) fall back to their own setup()
+        untouched -- a partial hand-off is better than none."""
+        from .tpu_element import ComputeElement
+        from .transfer import TENSOR_REF_KEY, fetch
+        from ..observe.metrics import get_registry
+
+        metrics = get_registry()
+
+        def materialize(node):
+            if isinstance(node, dict):
+                if TENSOR_REF_KEY in node:
+                    array = fetch(node[TENSOR_REF_KEY])
+                    metrics.counter("warm_start.imported_bytes").inc(
+                        array.nbytes)
+                    return array
+                return {key: materialize(value)
+                        for key, value in node.items()}
+            if isinstance(node, tuple) and hasattr(node, "_fields"):
+                # namedtuple pytree node (optimizer states etc.):
+                # the constructor takes fields positionally
+                return type(node)(*(materialize(value)
+                                    for value in node))
+            if isinstance(node, (list, tuple)):
+                return type(node)(materialize(value) for value in node)
+            if node is None:
+                return None
+            # leaves were all replaced by descriptor markers at export:
+            # anything else is a container this walk cannot rebuild
+            raise ValueError(
+                f"import_weights: unsupported state container "
+                f"{type(node).__name__} (dict/list/tuple pytrees only)")
+
+        installed = []
+        start = time.perf_counter()
+        for name, tree in (exported or {}).items():
+            element = self.elements.get(name)
+            if not isinstance(element, ComputeElement):
+                _LOGGER.warning("%s: import_weights has no local "
+                                "ComputeElement %r; skipped",
+                                self.name, name)
+                continue
+            element.restore_state(materialize(tree))
+            installed.append(name)
+        metrics.histogram("warm_start.import_s").record(
+            time.perf_counter() - start)
+        return installed
+
     def stop(self) -> None:
         self.telemetry.stop()  # final snapshot publish + timer teardown
         for stream_id in list(self.streams):

@@ -16,7 +16,8 @@ from .actor import Actor
 from .lease import Lease
 from .process_manager import ProcessManager
 from .proxy import make_proxy
-from .share import ECConsumer
+from .service import ServiceFilter
+from .share import ECConsumer, services_cache_create_singleton
 
 __all__ = ["LifeCycleManager", "LifeCycleClient"]
 
@@ -48,11 +49,28 @@ class LifeCycleManager(Actor):
         # all state mutation onto the event loop
         process.event.add_queue_handler(self._client_exit_queued,
                                         ["lifecycle_exit"])
+        process.event.add_queue_handler(self._client_lost_queued,
+                                        ["lifecycle_lost"])
+        # a client that crashes WITH LWT (severed broker connection)
+        # vanishes from the registrar before -- or instead of -- its OS
+        # exit being reaped: watch removals so the record (and any
+        # wedged zombie process) is reaped either way.  The bound
+        # method is stored ONCE: ServicesCache.remove_handler matches
+        # by identity, and a fresh `self._registrar_event` access would
+        # never equal the registered object
+        self._services_cache = services_cache_create_singleton(process)
+        self._registrar_watch = self._registrar_event
+        self._services_cache.add_handler(self._registrar_watch,
+                                         ServiceFilter())
 
     # -- creating clients --------------------------------------------------
 
     def create_client(self, command: str, arguments=(),
-                      use_interpreter: bool = True) -> int:
+                      use_interpreter: bool = True, env=None) -> int:
+        """`env` is merged over the parent environment by
+        ProcessManager.spawn: the elastic-fleet spawner pins
+        JAX_PLATFORMS, the persistent compile-cache directory, and
+        telemetry knobs on every replica child this way."""
         client_id = self._client_sequence
         self._client_sequence += 1
         self.clients[client_id] = {
@@ -65,7 +83,7 @@ class LifeCycleManager(Actor):
         self.process_manager.spawn(
             client_id, command,
             list(arguments) + [self.topic_path, str(client_id)],
-            use_interpreter=use_interpreter)
+            use_interpreter=use_interpreter, env=env)
         return client_id
 
     def _handshake_expired(self, client_id) -> None:
@@ -127,6 +145,25 @@ class LifeCycleManager(Actor):
     def _client_exit_queued(self, client_id) -> None:
         self._remove_client(client_id, kill=False)
 
+    def _registrar_event(self, command, fields) -> None:
+        """ServicesCache callback (message-pump side): a RUNNING
+        client's registrar entry vanished -- LWT fired on a severed
+        connection, or the service terminated without telling us.
+        Defer onto the event loop like the exit path."""
+        if command != "remove":
+            return
+        for client_id, record in list(self.clients.items()):
+            if (record["topic_path"] == fields.topic_path
+                    and record["state"] == "running"):
+                _LOGGER.warning("Client %s lost from registrar (LWT); "
+                                "reaping", client_id)
+                self.process.event.queue_put(client_id, "lifecycle_lost")
+
+    def _client_lost_queued(self, client_id) -> None:
+        # the broker connection died but the OS process may linger as a
+        # zombie: kill=True covers both
+        self._remove_client(client_id, kill=True)
+
     def _remove_client(self, client_id, kill: bool) -> None:
         record = self.clients.pop(client_id, None)
         if record is None:
@@ -150,6 +187,7 @@ class LifeCycleManager(Actor):
             self.share["client_count"] = len(self.clients)
 
     def stop(self) -> None:
+        self._services_cache.remove_handler(self._registrar_watch)
         for client_id in list(self.clients):
             self._remove_client(client_id, kill=True)
         self.process_manager.terminate()

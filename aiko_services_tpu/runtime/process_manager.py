@@ -43,20 +43,37 @@ class ProcessManager:
     def spawn(self, process_id, command: str, arguments=(),
               use_interpreter: bool = True,
               start_new_session: bool = False,
-              stdout=None, stderr=None):
+              stdout=None, stderr=None, env=None):
         """`start_new_session` detaches the child from the caller's
         terminal session (its own setsid), so closing the terminal
         does not SIGHUP it -- what `aiko system start` needs for a
         deployment that outlives the shell.  Detached children should
         also get their own `stdout`/`stderr` (a log file): inheriting
-        the caller's keeps any pipe on it open forever."""
+        the caller's keeps any pipe on it open forever.
+
+        `env` is an OVERLAY merged over the parent's os.environ, not a
+        replacement: autoscaled replica children must inherit the
+        ambient environment (PATH, PYTHONPATH, proxy settings) plus the
+        handful of knobs the spawner pins -- JAX_PLATFORMS, the
+        persistent compile-cache directory, telemetry switches.  A None
+        value in the overlay REMOVES that variable from the child."""
+        import os
         command_path = self.resolve_command(command)
         argv = ([sys.executable, command_path] if use_interpreter
                 else [command_path])
         argv += [str(argument) for argument in arguments]
+        merged_env = None
+        if env:
+            merged_env = dict(os.environ)
+            for key, value in env.items():
+                if value is None:
+                    merged_env.pop(str(key), None)
+                else:
+                    merged_env[str(key)] = str(value)
         child = subprocess.Popen(argv,
                                  start_new_session=start_new_session,
-                                 stdout=stdout, stderr=stderr)
+                                 stdout=stdout, stderr=stderr,
+                                 env=merged_env)
         with self._lock:
             self.processes[process_id] = {
                 "process": child, "command": command_path}

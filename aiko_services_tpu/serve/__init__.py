@@ -2,9 +2,15 @@
 # pipeline replicas with admission control (per-priority token buckets,
 # SLO-aware shedding), least-loaded routing (power-of-two-choices over
 # registrar-discovered replicas' EC load gauges), bounded backpressure
-# with `(throttle ...)` signals to DataSources, and mid-stream failover
-# that replays un-acknowledged frames on replica death.  See README
-# "Serving gateway".
+# with `(throttle ...)` signals to DataSources, mid-stream failover
+# that replays un-acknowledged frames on replica death, and an elastic
+# replica fleet (autoscale.py): watermark-driven scale up/down over the
+# lifecycle layer with warm-start replicas (persistent compile cache +
+# live sibling weight hand-off).  See README "Serving gateway" and
+# "Elastic scaling".
 
 from .policy import AdmissionPolicy, TokenBucket          # noqa: F401
 from .gateway import Gateway, SERVICE_PROTOCOL_GATEWAY    # noqa: F401
+from .autoscale import (                                  # noqa: F401
+    AutoScaler, InProcessReplicaFactory, ProcessReplicaFactory,
+    ScalePolicy)

@@ -97,10 +97,10 @@ class _LocalResponder:
 class _Replica:
     __slots__ = ("topic_path", "name", "pipeline", "consumer", "cache",
                  "outstanding", "streams", "dead", "saturated",
-                 "below_since", "routed")
+                 "below_since", "routed", "draining", "warm")
 
     def __init__(self, topic_path: str, name: str, pipeline=None,
-                 consumer=None, cache=None):
+                 consumer=None, cache=None, warm: bool = False):
         self.topic_path = topic_path
         self.name = name
         self.pipeline = pipeline      # local direct attach (else None)
@@ -109,6 +109,8 @@ class _Replica:
         self.outstanding = 0          # gateway-routed frames in flight
         self.streams: set[str] = set()
         self.dead = False
+        self.draining = False         # scale-down: no NEW placements
+        self.warm = warm              # warm-started (hand-off + cache)
         self.saturated = False
         self.below_since: float | None = None
         self.routed = 0
@@ -119,6 +121,11 @@ class _Replica:
         if self.pipeline is not None:
             return int(self.pipeline.load()["inflight"])
         return parse_int(self.cache.get("inflight", 0), 0)
+
+    def reported_queue_depth(self) -> int:
+        if self.pipeline is not None:
+            return int(self.pipeline.load()["queue_depth"])
+        return parse_int(self.cache.get("queue_depth", 0), 0)
 
     def score(self) -> int:
         """Routing load: the gateway's instant view of what it routed,
@@ -157,6 +164,7 @@ class _Replica:
     def placeable(self, now: float, policy: AdmissionPolicy) -> bool:
         self.note_load(now, policy)
         return (not self.dead
+                and not self.draining
                 and not self.saturated
                 and self.fresh(now, policy.stale_after_s))
 
@@ -195,7 +203,8 @@ class _GatewayStream:
 class Gateway(Actor):
     def __init__(self, process, name: str = "gateway", policy=None,
                  router_seed: int = 0, faults=None, telemetry: bool = True,
-                 metrics_interval: float = 10.0):
+                 metrics_interval: float = 10.0, autoscale=None,
+                 replica_factory=None):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
         # construction-time validation through the shared
         # directive-grammar core (analyze/grammar.py): a typo'd policy
@@ -228,11 +237,14 @@ class Gateway(Actor):
         self._throttle_on = False
         self._services_cache = None
         self._discovery_handler = None
+        self.autoscaler = None
         self.share.update({
             "policy": self.policy.spec,
             "replica_count": 0,
             "stream_count": 0,
         })
+        if autoscale is not None:
+            self.enable_autoscale(autoscale, replica_factory)
 
     def _post_message(self, actor_topic: str, command: str,
                       parameters) -> None:
@@ -240,19 +252,43 @@ class Gateway(Actor):
         # _LocalResponder): without this, an overload backlog in the
         # `in` mailbox starves every replica of slot-freeing responses
         if command in ("process_frame_response", "_release_dead_letter",
-                       "_replica_lost"):
+                       "_replica_lost", "_autoscale_ready"):
             from ..runtime import ActorTopic
             actor_topic = ActorTopic.CONTROL
         super()._post_message(actor_topic, command, parameters)
 
     # -- replica pool ------------------------------------------------------
 
-    def attach_replica(self, pipeline) -> None:
+    def attach_replica(self, pipeline, warm: bool = False) -> None:
         """Wire an in-process Pipeline as a replica (the bench/test fast
-        path: frame data and responses hand off as live objects)."""
+        path: frame data and responses hand off as live objects).
+        `warm` marks a warm-started replica (sibling weight hand-off +
+        persistent compile cache) for the pool telemetry."""
         replica = _Replica(pipeline.topic_path, pipeline.name,
-                          pipeline=pipeline)
+                          pipeline=pipeline, warm=warm)
         self._add_replica(replica)
+
+    # -- elastic fleet (serve/autoscale.py drives these) -------------------
+
+    def enable_autoscale(self, policy, factory=None) -> None:
+        """Attach the load-driven autoscaler: `policy` parses through
+        the shared directive grammar (AIKO406 on bad values, AIKO404 on
+        unknown directives, exactly like the admission policy), and
+        `factory` supplies/retires replicas (serve/autoscale.py
+        factories, or anything matching their spawn/retire shape)."""
+        from .autoscale import AutoScaler
+        if self.autoscaler is not None:
+            raise ValueError(f"{self.name}: autoscaler already enabled")
+        self.autoscaler = AutoScaler(self, policy, factory)
+
+    def _autoscale_ready(self, handle, info=None) -> None:
+        """Mailbox continuation for a finished spawn (the factory
+        thread must never touch gateway state directly).  Rides the
+        CONTROL mailbox: scale-ups happen exactly when the `in` mailbox
+        is drowning in queued submissions, and an attach parked behind
+        them would arrive after the overload it was meant to absorb."""
+        if self.autoscaler is not None:
+            self.autoscaler.spawn_finished(handle, info or {})
 
     def discover(self, service_filter: ServiceFilter = None,
                  **filter_kwargs) -> None:
@@ -299,6 +335,9 @@ class Gateway(Actor):
         self.process.add_message_handler(
             self._dead_letter_handler,
             f"{replica.topic_path}/dead_letter")
+        if self.autoscaler is not None:
+            # closes a pending discovered spawn's time-to-healthy clock
+            self.autoscaler.note_replica_added(replica)
         self._update_share()
         _LOGGER.info("%s: replica %s (%s) joined", self.name,
                      replica.name, replica.topic_path)
@@ -324,15 +363,53 @@ class Gateway(Actor):
         if self.replicas.pop(replica.topic_path, None) is None:
             return  # already failed over (e.g. kill then discovery remove)
         replica.dead = True
+        self._detach_replica(replica)
+        self.telemetry.replica_deaths.inc()
+        _LOGGER.warning("%s: replica %s died (%s); failing over %d "
+                        "streams", self.name, replica.name, reason,
+                        len(replica.streams))
+        self._migrate_streams(replica)
+        self._update_share()
+        # frames that parked while the replica was dying (dispatch saw
+        # replica.dead before this cleanup ran) have no response left to
+        # trigger a drain -- kick it now that streams are re-pinned
+        self._drain_parked()
+
+    def drain_replica(self, topic_path: str,
+                      reason: str = "scale_down"):
+        """Graceful retirement (the autoscaler's low-watermark path):
+        leave the pool, stop attracting placements, and re-pin every
+        pinned stream through the SAME zero-loss migration the death
+        path uses -- destroy on the old replica, replay un-acked frames
+        from the stream cursor on the new one, duplicates deduped.  The
+        replica object is returned so the caller can retire the backing
+        process after its in-flight responses settle; returns None when
+        the topic is not in the pool."""
+        replica = self.replicas.pop(str(topic_path), None)
+        if replica is None:
+            return None
+        replica.draining = True
+        self._detach_replica(replica)
+        _LOGGER.info("%s: draining replica %s (%s); migrating %d "
+                     "streams", self.name, replica.name, reason,
+                     len(replica.streams))
+        self._migrate_streams(replica)
+        self._update_share()
+        self._drain_parked()
+        return replica
+
+    def _detach_replica(self, replica: _Replica) -> None:
         self.process.remove_message_handler(
             self._dead_letter_handler,
             f"{replica.topic_path}/dead_letter")
         if replica.consumer is not None:
             replica.consumer.terminate()
-        self.telemetry.replica_deaths.inc()
-        _LOGGER.warning("%s: replica %s died (%s); failing over %d "
-                        "streams", self.name, replica.name, reason,
-                        len(replica.streams))
+
+    def _migrate_streams(self, replica: _Replica) -> None:
+        """Re-pin every stream pinned to `replica` and replay its
+        un-acknowledged frames -- the zero-loss path shared by failover
+        (replica death) and drain (scale-down).  The replica must
+        already be out of self.replicas so placement cannot choose it."""
         for stream_id in list(replica.streams):
             self._send_destroy(replica, stream_id)
         now = time.monotonic()
@@ -372,11 +449,6 @@ class Gateway(Actor):
                     self._send_frame(target, stream, frame_id, entry)
                 else:
                     self._park(stream, frame_id, entry[2])
-        self._update_share()
-        # frames that parked while the replica was dying (dispatch saw
-        # replica.dead before this cleanup ran) have no response left to
-        # trigger a drain -- kick it now that streams are re-pinned
-        self._drain_parked()
 
     # -- placement ---------------------------------------------------------
 
@@ -923,13 +995,37 @@ class Gateway(Actor):
 
     # -- observability -----------------------------------------------------
 
+    def pool_snapshot(self) -> dict:
+        """Per-replica pool view (replica topic, state, load gauges,
+        warm/cold) -- rendered by `aiko system status` and the
+        dashboard's `pool:` row; rides the periodic telemetry summary
+        into the EC share so remote observers see it."""
+        pool = {}
+        draining = (self.autoscaler.draining.values()
+                    if self.autoscaler is not None else ())
+        for replica in list(self.replicas.values()) + list(draining):
+            pool[replica.name] = {
+                "topic": replica.topic_path,
+                "state": "draining" if replica.draining else "live",
+                "outstanding": replica.outstanding,
+                "inflight": replica.reported_inflight(),
+                "queue_depth": replica.reported_queue_depth(),
+                "streams": len(replica.streams),
+                "warm": replica.warm,
+            }
+        return pool
+
     def _update_share(self) -> None:
         self.telemetry.replicas.set(len(self.replicas))
+        self.telemetry.pool_size.set(len(self.replicas))
         if self.ec_producer is not None:
             self.ec_producer.update("replica_count", len(self.replicas))
             self.ec_producer.update("stream_count", len(self.streams))
 
     def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+            self.autoscaler = None
         self.telemetry.stop()
         for stream_id in list(self.streams):
             self.destroy_stream(stream_id)

@@ -1270,6 +1270,338 @@ def bench_router(peak, replicas_n: int):
     }
 
 
+# -- autoscale: the elastic fleet under a mid-run load doubling --------------
+
+# one spec, three surfaces: the running gateway's autoscaler, the
+# definition parameter `aiko lint --bench` checks (AIKO406), and the
+# published config block
+_AUTOSCALE_POLICY = ("min_replicas=1;max_replicas=2;high_water=0.6;"
+                     "low_water=0.01;cooldown=1;interval=0.1")
+
+
+def bench_autoscale(peak):
+    """`autoscale` config: the serving workload behind the gateway with
+    the elastic replica fleet enabled.  Closed-loop session load (N
+    concurrent bounded sessions, each keeping a window of frames in
+    flight) DOUBLES mid-run; the autoscaler must spawn a warm replica
+    (persistent compile cache + sibling weight hand-off over the
+    transfer plane) and goodput must recover with NO manual replica
+    attach.  Published: time-to-healthy for every spawned replica --
+    the cold baseline bring-up through the SAME factory vs the warm
+    spawn -- plus the warm replica's compile-cache delta
+    (`compiles_in_window == 0` is the warm-start proof CI asserts) and
+    goodput before/during/after the spike."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aiko_services_tpu.models import detector_flops_per_image
+    from aiko_services_tpu.models.configs import DETECTOR_TOY, YOLOV8N_SHAPE
+    from aiko_services_tpu.runtime import Process, disable_compile_cache
+    from aiko_services_tpu.serve import Gateway, InProcessReplicaFactory
+
+    config = DETECTOR_TOY if SMOKE else YOLOV8N_SHAPE
+    preset = "toy" if SMOKE else "yolov8n"
+    size = config.image_size
+    micro = 4 if SMOKE else 16
+    streams_n = 4 if SMOKE else 16
+    images = [
+        jax.random.uniform(jax.random.PRNGKey(index), (1, 3, size, size),
+                           jnp.float32)
+        for index in range(4)]
+    cache_dir = tempfile.mkdtemp(prefix="aiko_compile_cache_")
+
+    def definition(name):
+        return _serving_definition(
+            name, size,
+            {"telemetry": TELEMETRY, "metrics_interval": 60.0,
+             "autoscale_policy": _AUTOSCALE_POLICY},
+            {"preset": preset, "micro_batch": micro,
+             "dtype": "float32" if SMOKE else "bfloat16"})
+
+    factory = InProcessReplicaFactory(
+        definition, warmup={"image": images[0]},
+        compile_cache=cache_dir)
+
+    # phase 1: replica0 comes up COLD through the same factory the
+    # autoscaler will use -- it pays the XLA compiles once (populating
+    # the shared cache) and its bring-up is the warm spawn's baseline
+    cold_ready = queue.Queue()
+    cold_start = time.perf_counter()
+    factory.spawn("replica0",
+                  ready=lambda handle, info: cold_ready.put(
+                      (handle, info)))
+    handle0, cold_info = cold_ready.get(timeout=900)
+    if handle0 is None:
+        raise RuntimeError(f"cold replica bring-up failed: {cold_info}")
+    time_to_healthy_cold_ms = (time.perf_counter() - cold_start) * 1000.0
+
+    # phase 2: the gateway fronting replica0; capacity is measured
+    # CLOSED-LOOP THROUGH THE GATEWAY (submit on completion), because
+    # the offered rates must saturate the serving path the autoscaler
+    # watches -- the raw pipeline is faster than the routed path on a
+    # shared host, and calibrating against it would just shed
+    pipeline = handle0.pipeline
+    gateway_process = Process(transport_kind="loopback")
+    # sized against the closed-loop session load below: base = N
+    # sessions x a `micro` window = 0.5 of one replica's cap (under the
+    # 0.6 high watermark), the doubling = 1.0 (over it) -- so the
+    # controller fires ON the spike, not during the base phase
+    policy = (f"max_inflight={8 * micro};"
+              f"queue={16 * micro * streams_n}")
+    gateway = Gateway(gateway_process, policy=policy, router_seed=7,
+                      telemetry=True, metrics_interval=60.0)
+    gateway.attach_replica(pipeline)
+    gateway_process.run(in_thread=True)
+
+    gateway_responses = queue.Queue()
+    for index in range(streams_n):
+        gateway.submit_stream(f"g{index}",
+                              queue_response=gateway_responses)
+    for index in range(streams_n):
+        gateway.submit_frame(f"g{index}", {"image": images[index % 4]})
+    warm_refs = []
+    for _ in range(streams_n):
+        _, _, outputs, status = gateway_responses.get(timeout=900)
+        if status == "ok":
+            warm_refs.append(outputs.get("detections"))
+    _barrier(warm_refs)
+
+    cursors = {f"g{index}": 1 for index in range(streams_n)}
+
+    def submit_next(index):
+        stream_id = f"g{index % streams_n}"
+        frame_id = cursors[stream_id]
+        cursors[stream_id] += 1
+        gateway.submit_frame(stream_id, {"image": images[index % 4]},
+                             frame_id=frame_id)
+
+    per_stream = 4 if SMOKE else 30
+    probe_total = streams_n * per_stream
+    window = 2 * micro
+    start = time.perf_counter()
+    probe_refs = []
+    for index in range(min(window, probe_total)):
+        submit_next(index)
+    issued = min(window, probe_total)
+    for _ in range(probe_total):
+        _, _, outputs, status = gateway_responses.get(timeout=900)
+        if status == "ok":
+            probe_refs.append(outputs.get("detections"))
+        if issued < probe_total:
+            submit_next(issued)
+            issued += 1
+    capacity = probe_total / _honest_elapsed(start, probe_refs)
+    for index in range(streams_n):
+        gateway.post_message("destroy_stream", [f"g{index}"])
+
+    # phase 3: base load, then the mid-run doubling -- only now does
+    # the autoscaler watch (the probe's deliberate saturation must not
+    # pre-trigger it).  Load is CLOSED-LOOP SESSION traffic: N
+    # concurrent sessions, each keeping `window_per_session` frames in
+    # flight (N users awaiting responses), and the doubling arrives as
+    # N MORE sessions.  Sessions are bounded (`session_frames`) and
+    # replaced on completion, so successors RE-PLACE on whatever pool
+    # exists -- streams pin to a replica for their lifetime, and a load
+    # swing made of immortal pinned streams could never use a grown
+    # pool.  A session rejected at admission (typed `overloaded` while
+    # every replica is saturated) is retried shortly after, like a real
+    # client.
+    gateway.enable_autoscale(_AUTOSCALE_POLICY, factory)
+    window_per_session = micro
+    session_frames = 10 * micro
+    base_window = 1.5 if SMOKE else 3.0
+    # the spike must outlive the warm bring-up: recovery is only
+    # observable once the second replica is serving (and on a
+    # shared-CPU smoke host, the bring-up itself steals cycles)
+    spike_window = 8.0 if SMOKE else 10.0
+    completions = []                      # perf_counter per ok frame
+    counts = {"ok": 0, "shed": 0, "error": 0, "rejected_sessions": 0}
+    ok_refs = []
+    done = threading.Event()
+    offering_done = threading.Event()
+    lock = threading.Lock()
+    sessions: dict = {}    # id -> {"cursor", "outstanding"}
+    state = {"sequence": 0}
+
+    def submit_one(stream_id, session):
+        frame_id = session["cursor"]
+        session["cursor"] += 1
+        session["outstanding"] += 1
+        gateway.submit_frame(stream_id,
+                             {"image": images[frame_id % 4]},
+                             frame_id=frame_id)
+
+    def open_session():
+        with lock:
+            stream_id = f"sess{state['sequence']}"
+            state["sequence"] += 1
+            session = sessions[stream_id] = {"cursor": 0,
+                                             "outstanding": 0}
+        gateway.submit_stream(stream_id,
+                              queue_response=gateway_responses)
+        for _ in range(window_per_session):
+            submit_one(stream_id, session)
+
+    def drain():
+        # the closed loop lives HERE: each ok/shed response funds the
+        # session's next frame; an exhausted session is destroyed and
+        # replaced (placement sees the CURRENT pool).  Timestamps are
+        # engine-completion times (no per-frame device sync: on a
+        # shared-CPU host a blocking sync in this thread becomes the
+        # bottleneck); the final _honest_elapsed barrier keeps the
+        # OVERALL number device-honest
+        retry_at: list = []
+        while True:
+            now = time.perf_counter()
+            while retry_at and retry_at[0] <= now:
+                retry_at.pop(0)
+                if not offering_done.is_set():
+                    open_session()
+            try:
+                stream_id, frame_id, outputs, status = (
+                    gateway_responses.get(
+                        timeout=0.05 if retry_at else 2.0))
+            except queue.Empty:
+                if offering_done.is_set() and not any(
+                        session["outstanding"]
+                        for session in sessions.values()):
+                    break
+                continue
+            if status == "overloaded":
+                counts["rejected_sessions"] += 1
+                sessions.pop(stream_id, None)
+                retry_at.append(time.perf_counter() + 0.1)
+                continue
+            if status == "ok":
+                completions.append(time.perf_counter())
+                ok_refs.append(outputs.get("detections"))
+                counts["ok"] += 1
+            else:
+                counts[status if status in counts else "error"] += 1
+            session = sessions.get(stream_id)
+            if session is None:
+                continue
+            session["outstanding"] -= 1
+            if offering_done.is_set():
+                continue
+            if session["cursor"] < session_frames:
+                submit_one(stream_id, session)
+            elif session["outstanding"] <= 0:
+                gateway.post_message("destroy_stream", [stream_id])
+                sessions.pop(stream_id, None)
+                open_session()
+        done.set()
+
+    pool_grew_at = []
+
+    def watch_pool():
+        while not done.is_set():
+            if len(gateway.replicas) >= 2:
+                pool_grew_at.append(time.perf_counter())
+                return
+            time.sleep(0.01)
+
+    threading.Thread(target=watch_pool, daemon=True).start()
+    start = time.perf_counter()
+    for _ in range(streams_n):
+        open_session()
+    threading.Thread(target=drain, daemon=True).start()
+    time.sleep(base_window)
+    spike_started_at = time.perf_counter()
+    for _ in range(streams_n):   # the doubling: N more sessions
+        open_session()
+    time.sleep(spike_window)
+    offer_end = time.perf_counter()
+    offering_done.set()
+    done.wait(timeout=900)
+    offered = counts["ok"] + counts["shed"] + counts["error"]
+    elapsed = _honest_elapsed(start, ok_refs)
+
+    def goodput_in(window_start, window_end):
+        if window_end <= window_start:
+            return None
+        inside = sum(1 for moment in completions
+                     if window_start <= moment <= window_end)
+        return inside / (window_end - window_start)
+
+    goodput_base = goodput_in(start, spike_started_at or offer_end)
+    goodput_spike = goodput_in(spike_started_at or offer_end, offer_end)
+    # the recovery window: from shortly after the pool actually grew
+    # (the warm replica is serving and its bring-up no longer steals
+    # host cycles) to the end of the offered spike; if the pool never
+    # grew, fall back to the final quarter of the spike
+    if pool_grew_at:
+        recovery_start = min(pool_grew_at[0] + 1.0, offer_end)
+    else:
+        recovery_start = (spike_started_at or start) + 0.75 * (
+            offer_end - (spike_started_at or start))
+    goodput_recovered = goodput_in(recovery_start, offer_end)
+
+    spawns = list(gateway.autoscaler.spawns)
+    summary = gateway.telemetry.summary()
+    scale_latency_s = (
+        round(pool_grew_at[0] - spike_started_at, 3)
+        if pool_grew_at and spike_started_at else None)
+    # gateway teardown retires every factory-owned replica; replica0
+    # was spawned directly (not autoscaler-owned), so it is ours
+    gateway_process.terminate()
+    handle0.process.terminate()
+    disable_compile_cache()
+    shutil.rmtree(cache_dir, ignore_errors=True)
+
+    warm_spawn = next((spawn for spawn in spawns if spawn["warm"]),
+                      spawns[0] if spawns else None)
+    flops = detector_flops_per_image(config)
+    return {
+        "model": f"{preset} {size}x{size}",
+        "policy": policy,
+        "autoscale": _AUTOSCALE_POLICY,
+        "topology": "in-process replicas, shared host",
+        "capacity_single_fps": round(capacity, 1),
+        "sessions_base": streams_n,
+        "sessions_spike": 2 * streams_n,      # the mid-run doubling
+        "window_per_session": window_per_session,
+        "session_frames": session_frames,
+        "responses": offered,
+        "goodput_base_fps": (round(goodput_base, 1)
+                             if goodput_base is not None else None),
+        "goodput_spike_fps": (round(goodput_spike, 1)
+                              if goodput_spike is not None else None),
+        "goodput_recovered_fps": (round(goodput_recovered, 1)
+                                  if goodput_recovered is not None
+                                  else None),
+        "recovered_vs_single_capacity": (
+            round(goodput_recovered / max(capacity, 1e-9), 2)
+            if goodput_recovered is not None else None),
+        "completed": counts["ok"],
+        "shed": counts["shed"],
+        "rejected_sessions": counts["rejected_sessions"],
+        "errors": counts["error"],
+        "goodput_overall_fps": round(counts["ok"] / elapsed, 1),
+        "scale_ups": summary["scale_ups"],
+        "scale_latency_s": scale_latency_s,
+        "time_to_healthy_cold_ms": round(time_to_healthy_cold_ms, 1),
+        "cold_compiles": cold_info.get("cache_misses"),
+        "spawns": spawns,
+        "time_to_healthy_warm_ms": (warm_spawn["time_to_healthy_ms"]
+                                    if warm_spawn else None),
+        "warm_vs_cold_speedup": (
+            round(time_to_healthy_cold_ms
+                  / max(warm_spawn["time_to_healthy_ms"], 1e-9), 2)
+            if warm_spawn else None),
+        # the CI-asserted warm-start proof: zero recompiles of
+        # fleet-known shapes during the warm replica's bring-up
+        "compiles_in_window": (warm_spawn.get("cache_misses")
+                               if warm_spawn else None),
+        "mfu": _mfu((goodput_recovered or 0.0) * flops, peak),
+    }
+
+
 # -- config 6b: continuous batching (decode/ engine) -------------------------
 
 def bench_continuous(peak):
@@ -1564,6 +1896,12 @@ def collect_definitions() -> dict:
             {"telemetry": TELEMETRY, "metrics_interval": 60.0},
             {"preset": det_preset, "micro_batch": serving_micro,
              "dtype": "float32" if SMOKE else "bfloat16"}),
+        "autoscale": _serving_definition(
+            "bench_autoscale", det_config.image_size,
+            {"telemetry": TELEMETRY, "metrics_interval": 60.0,
+             "autoscale_policy": _AUTOSCALE_POLICY},
+            {"preset": det_preset, "micro_batch": serving_micro,
+             "dtype": "float32" if SMOKE else "bfloat16"}),
         "tts": _tts_definition(
             "hello" if SMOKE else
             "the quick brown fox jumps over the lazy dog",
@@ -1588,6 +1926,8 @@ _SUMMARY_FIELDS = (
     ("serving", "coalescing_speedup", "serving_speedup"),
     ("serving", "frames_per_sec_total", "serving_fps"),
     ("latency", "p50_ms", "latency_p50_ms"),
+    ("autoscale", "time_to_healthy_warm_ms", "tth_warm_ms"),
+    ("autoscale", "warm_vs_cold_speedup", "warm_speedup"),
     ("tts", "mfu", "tts_mfu"),
     ("pipeline_multimodal", "mfu", "headline_mfu"),
     ("pipeline_multimodal", "audio_realtime_factor", "audio_rt"),
@@ -1689,8 +2029,8 @@ def main() -> None:
 
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
-                       "longcontext,serving,continuous,latency,tts,"
-                       "pipeline")
+                       "longcontext,serving,continuous,autoscale,"
+                       "latency,tts,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -1714,6 +2054,8 @@ def main() -> None:
         configs["continuous"] = bench_continuous(peak)
     if router_replicas is not None or "router" in wanted:
         configs["router"] = bench_router(peak, router_replicas or 2)
+    if "autoscale" in wanted:
+        configs["autoscale"] = bench_autoscale(peak)
     if "latency" in wanted:
         configs["latency"] = bench_latency(peak)
     if "tts" in wanted:

@@ -297,6 +297,8 @@ class TestLifeCycle:
             process.terminate()
 
     def test_handshake_timeout_kills_client(self, tmp_path):
+        # reap path 1: handshake-lease lapse -- the OS child came up
+        # but never announced; the lease kills it and drops the record
         manager_process = Process(transport_kind="loopback")
         manager = LifeCycleManager(manager_process, "lcm2",
                                    handshake_lease_time=0.2)
@@ -306,4 +308,71 @@ class TestLifeCycle:
         client_id = manager.create_client(str(sleeper))
         wait_for(lambda: client_id not in manager.clients, timeout=10)
         assert client_id not in manager.process_manager
+        manager_process.terminate()
+
+    def test_client_crash_with_lwt_reaps_record_and_zombie(self,
+                                                           tmp_path):
+        """Reap path 2: the client's broker connection dies (severed
+        transport, the fault harness's crash primitive) -- LWT
+        "(absent)" fires, the registrar removes the client's services,
+        and the manager's registrar watch must reap the record AND the
+        wedged OS child, even though the child process never exited on
+        its own."""
+        registrar_process = Process(transport_kind="loopback")
+        Registrar(registrar_process, search_timeout=0.05)
+        registrar_process.run(in_thread=True)
+
+        manager_process = Process(transport_kind="loopback")
+        changes = []
+        manager = LifeCycleManager(
+            manager_process, "lcm3",
+            client_change_handler=lambda cmd, cid: changes.append(
+                (cmd, cid)))
+        manager_process.run(in_thread=True)
+
+        sleeper = tmp_path / "sleeper.py"
+        sleeper.write_text("import time; time.sleep(30)\n")
+        client_id = manager.create_client(str(sleeper))
+
+        client_process = Process(transport_kind="loopback")
+        LifeCycleClient(client_process, "worker3",
+                        manager.topic_path, client_id)
+        client_process.run(in_thread=True)
+        wait_for(lambda: manager.clients.get(
+            client_id, {}).get("state") == "running", timeout=10)
+        assert client_id in manager.process_manager  # sleeper alive
+
+        client_process.transport.sever()  # crash WITH LWT
+        wait_for(lambda: client_id not in manager.clients, timeout=15)
+        assert ("remove", client_id) in changes
+        # kill=True: the zombie OS child goes too
+        wait_for(lambda: client_id not in manager.process_manager,
+                 timeout=15)
+        for process in (registrar_process, manager_process):
+            process.terminate()
+
+    def test_exit_handler_delivered_off_monitor_thread(self, tmp_path):
+        """Reap path 3: an OS child exit is observed on the
+        ProcessManager MONITOR thread, but every state mutation (record
+        removal, change handler) must land on the manager's event loop
+        -- the single-threaded scheduler the rest of the actor's state
+        assumes."""
+        import threading
+
+        manager_process = Process(transport_kind="loopback")
+        removals = []
+        manager = LifeCycleManager(
+            manager_process, "lcm4",
+            client_change_handler=lambda cmd, cid: removals.append(
+                (cmd, cid, threading.current_thread().name)))
+        manager_process.run(in_thread=True)
+        quick = tmp_path / "quick.py"
+        quick.write_text("import sys; sys.exit(0)\n")
+        client_id = manager.create_client(str(quick))
+        wait_for(lambda: client_id not in manager.clients, timeout=15)
+        wait_for(lambda: removals, timeout=10)
+        command, removed_id, thread_name = removals[0]
+        assert (command, removed_id) == ("remove", client_id)
+        assert thread_name != "process-manager"   # not the monitor
+        assert thread_name.endswith("-loop")      # the event loop
         manager_process.terminate()
