@@ -65,6 +65,7 @@ RULES = {
     "AIKO404": ("error", "unknown directive in a policy grammar"),
     "AIKO405": ("error", "invalid continuous-batching decode parameter"),
     "AIKO406": ("error", "invalid autoscale policy spec"),
+    "AIKO407": ("error", "invalid gateway HA/journal policy spec"),
 }
 
 

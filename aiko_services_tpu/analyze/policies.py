@@ -15,8 +15,8 @@ from .grammar import Field
 
 __all__ = ["run_policy_pass", "check_gateway_policy",
            "check_autoscale_policy", "check_faults_spec",
-           "check_decode_parameters", "FAULT_TOLERANCE_FIELDS",
-           "DECODE_FIELDS"]
+           "check_journal_policy", "check_decode_parameters",
+           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -126,6 +126,22 @@ def check_gateway_policy(spec) -> list:
     return problems
 
 
+def check_journal_policy(spec) -> list:
+    """(code, message) problems in a gateway HA/journal spec.  Same
+    shape as check_gateway_policy: the per-directive grammar check,
+    then the REAL JournalPolicy.parse so the cross-field constraint
+    (backend=sqlite requires path=) fails offline exactly as it would
+    at Gateway construction."""
+    from ..serve.journal import JOURNAL_GRAMMAR, JournalPolicy
+    problems = JOURNAL_GRAMMAR.check(spec, value_code="AIKO407")
+    if not problems:
+        try:
+            JournalPolicy.parse(spec)
+        except ValueError as error:
+            problems.append(("AIKO407", str(error)))
+    return problems
+
+
 def check_autoscale_policy(spec) -> list:
     """(code, message) problems in an elastic-fleet autoscale spec.
     Same shape as check_gateway_policy: the per-directive grammar
@@ -179,5 +195,9 @@ def run_policy_pass(definition) -> AnalysisReport:
     autoscale_spec = (definition.parameters or {}).get("autoscale_policy")
     if autoscale_spec:
         for code, message in check_autoscale_policy(autoscale_spec):
+            report.add(Diagnostic(code, message, definition=name))
+    journal_spec = (definition.parameters or {}).get("journal_policy")
+    if journal_spec:
+        for code, message in check_journal_policy(journal_spec):
             report.add(Diagnostic(code, message, definition=name))
     return report

@@ -235,6 +235,192 @@ def _lint_golden(corpus: "Path", passes) -> int:
     return 1 if failures or not checked else 0
 
 
+@main.group()
+def deadletter() -> None:
+    """Inspect and drain dead-lettered frames after a recovered
+    outage: `ls` lists the Recorder's dead-letter ring, `replay`
+    re-submits a selected frame through the serving gateway (frames
+    small enough to embed their encoded inputs replay exactly; larger
+    ones are descriptor-only evidence)."""
+
+
+def fetch_dead_letters(process, wait: float = 3.0) -> list:
+    """Drain the first discovered Recorder's dead-letter ring: decoded
+    {"index", "topic", "meta", "descriptor"} records, oldest first.
+    Shared by `aiko deadletter ls|replay` and tests."""
+    import json
+    import threading
+
+    from .runtime import ServiceFilter
+    from .runtime.recorder import SERVICE_PROTOCOL_RECORDER
+    from .runtime.storage import do_request
+
+    done = threading.Event()
+    collected: list = []
+
+    def on_items(items):
+        collected.extend(items)
+        done.set()
+
+    do_request(process, ServiceFilter(protocol=SERVICE_PROTOCOL_RECORDER),
+               lambda proxy, response_topic:
+               proxy.deadletters(response_topic),
+               on_items)
+    done.wait(wait)
+    records = []
+    for item in collected:
+        try:
+            records.append(json.loads(item))
+        except (TypeError, ValueError):
+            continue
+    return records
+
+
+def replay_dead_letter(process, record: dict, gateway_topic: str,
+                       create: bool = True, grace_time: float = 60.0,
+                       topic_response: str = "") -> bool:
+    """Re-submit one dead-lettered frame through a gateway: optionally
+    (re)create the stream (a duplicate create gets a harmless typed
+    reject), then publish the EXACT embedded frame data under its
+    original stream/frame identity -- the gateway's exactly-once dedupe
+    makes replay idempotent.  `topic_response` routes the outcome back
+    to the caller.  Returns False when the record carries no embedded
+    data (it exceeded AIKO_DEAD_LETTER_DATA_MAX)."""
+    import json
+
+    from .utils import generate
+
+    meta = record.get("meta") or {}
+    data = meta.get("data")
+    if not data:
+        return False
+    stream_id = str(meta.get("stream_id", ""))
+    frame_id = meta.get("frame_id", 0)
+    if create:
+        process.publish(
+            f"{gateway_topic}/in",
+            generate("create_stream", [
+                stream_id, json.dumps({}).encode("ascii"), grace_time,
+                topic_response]))
+    process.publish(
+        f"{gateway_topic}/in",
+        generate("process_frame", [
+            {"stream_id": stream_id, "frame_id": frame_id},
+            str(data).encode("ascii")]))
+    return True
+
+
+def _discover_gateway_topic(process, wait: float) -> str | None:
+    import threading
+
+    from .runtime import ServiceFilter
+    from .runtime.storage import do_command
+    from .serve import SERVICE_PROTOCOL_GATEWAY
+
+    found = threading.Event()
+    topics: list = []
+
+    def on_proxy(proxy):
+        # RemoteProxy exposes its /in topic; the service root is its
+        # parent (any non-underscore attribute would proxy a call)
+        topics.append(proxy._topic_in.rsplit("/in", 1)[0])
+        found.set()
+
+    do_command(process, ServiceFilter(protocol=SERVICE_PROTOCOL_GATEWAY),
+               on_proxy)
+    found.wait(wait)
+    return topics[0] if topics else None
+
+
+@deadletter.command("ls")
+@click.option("--transport", default=None)
+@click.option("--wait", default=3.0, help="Discovery/response wait (s)")
+def deadletter_ls(transport: str | None, wait: float) -> None:
+    """List the fleet's dead-lettered frames (newest last)."""
+    from .runtime import Process
+    process = Process(transport_kind=transport)
+    process.run(in_thread=True)
+    try:
+        records = fetch_dead_letters(process, wait=wait)
+        if not records:
+            click.echo("no dead letters (or no recorder discovered)")
+            return
+        for record in records:
+            meta = record.get("meta") or {}
+            click.echo(
+                f"[{record.get('index')}] {meta.get('stream_id')}"
+                f"/{meta.get('frame_id')} node={meta.get('node')} "
+                f"reason={meta.get('reason')} "
+                f"data={'yes' if meta.get('data') else 'no'} "
+                f"diag={str(meta.get('diagnostic', ''))[:60]}")
+    finally:
+        process.terminate()
+
+
+@deadletter.command("replay")
+@click.argument("index", type=int)
+@click.option("--gateway", default=None,
+              help="Gateway topic path (default: discover one)")
+@click.option("--transport", default=None)
+@click.option("--wait", default=3.0)
+@click.option("--create/--no-create", "create_stream", default=True,
+              help="Re-create the stream first (idempotent)")
+def deadletter_replay(index: int, gateway: str | None,
+                      transport: str | None, wait: float,
+                      create_stream: bool) -> None:
+    """Re-submit dead letter INDEX through the gateway."""
+    from .runtime import Process
+    process = Process(transport_kind=transport)
+    process.run(in_thread=True)
+    try:
+        records = {record.get("index"): record
+                   for record in fetch_dead_letters(process, wait=wait)}
+        record = records.get(index)
+        if record is None:
+            raise click.ClickException(
+                f"no dead letter at index {index} "
+                f"(have {sorted(records)})")
+        topic = gateway or _discover_gateway_topic(process, wait)
+        if not topic:
+            raise click.ClickException(
+                "no gateway given and none discovered")
+        import threading
+
+        from .utils import parse
+        outcome = {}
+        done = threading.Event()
+        response_topic = (f"{process.topic_path_process}/0/"
+                          f"deadletter_replay")
+
+        def on_response(_topic, payload):
+            try:
+                command, parameters = parse(payload)
+            except ValueError:
+                return
+            if command == "process_frame_response" and parameters:
+                reply = parameters[0] if isinstance(parameters[0],
+                                                    dict) else {}
+                outcome["status"] = reply.get("event") or "ok"
+                done.set()
+            elif command == "overloaded":
+                outcome["status"] = "overloaded"
+                done.set()
+
+        process.add_message_handler(on_response, response_topic)
+        if not replay_dead_letter(process, record, topic,
+                                  create=create_stream,
+                                  topic_response=response_topic):
+            raise click.ClickException(
+                "record has no embedded frame data (frame exceeded "
+                "AIKO_DEAD_LETTER_DATA_MAX when it was dead-lettered)")
+        done.wait(wait)
+        click.echo(f"replayed {record['meta'].get('stream_id')}"
+                   f"/{record['meta'].get('frame_id')} via {topic}: "
+                   f"{outcome.get('status', 'no response within wait')}")
+    finally:
+        process.terminate()
+
+
 @main.command()
 def bench() -> None:
     """Run the standard benchmark (one JSON line)."""

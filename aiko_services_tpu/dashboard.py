@@ -121,6 +121,19 @@ def _gateway_plugin(model: "DashboardModel") -> list:
         pool_line += (f"  time_to_healthy "
                       f"{metrics.get('time_to_healthy_ms')}ms")
     lines.append(pool_line)
+    ha = metrics.get("ha")
+    if isinstance(ha, dict):
+        ha_line = (
+            f"ha: role {ha.get('role', '?')}  "
+            f"journal {ha.get('backend', '?')} "
+            f"({ha.get('journal_entries', 0)} entries, "
+            f"{ha.get('journal_appends', 0)} appends)  "
+            f"takeovers {ha.get('takeovers', 0)}  "
+            f"replayed {ha.get('replayed', 0)}  "
+            f"stale {ha.get('dropped_stale', 0)}")
+        if "takeover_ms" in ha:
+            ha_line += f"  last_takeover {ha.get('takeover_ms')}ms"
+        lines.append(ha_line)
     pool = metrics.get("pool")
     if isinstance(pool, dict):
         for name in sorted(pool):

@@ -20,6 +20,27 @@
 #                    replica by name, frame=k kills it on the k-th frame
 #                    routed to it)
 #
+# Process-scoped points (the chaos harness: whole processes die, the
+# broker partitions -- exercised by `bench.py` `chaos` and
+# tests/test_chaos.py):
+#
+#   process_kill     a whole process dies abnormally: ProcessManager
+#                    consults it once per monitor poll per OS child
+#                    (node= the process id) and kills the match; chaos
+#                    harnesses consult it per tick for VIRTUAL
+#                    processes and crash them via Process.crash() /
+#                    LoopbackTransport.sever() (LWT fires, no clean
+#                    shutdown)
+#   broker_partition a client's path to the broker drops BOTH ways for
+#                    ms= milliseconds: LoopbackTransport consults it
+#                    per publish when `chaos_name` is set (partition +
+#                    scheduled heal; ms=0 partitions until heal() is
+#                    called manually)
+#   registrar_kill   the registrar primary dies abnormally (harness-
+#                    consulted like process_kill, but named so a chaos
+#                    plan reads as intent: the election/reap path is
+#                    the thing under test)
+#
 # Determinism contract: rate-based selection hashes (seed, point, node,
 # frame_id) -- the SAME frames are poisoned on every run with the same
 # seed, independent of call order, thread timing, or how many other
@@ -32,7 +53,8 @@
 #   directive := "seed=" int
 #              | point (":" key "=" value)*
 #   point     := element_raise | fetch_drop | reply_blackhole
-#              | dispatch_delay | connection_drop
+#              | dispatch_delay | connection_drop | replica_kill
+#              | process_kill | broker_partition | registrar_kill
 #   keys      := node=<name> frame=<int> rate=<float 0..1>
 #                times=<int, -1 = unlimited> ms=<float>
 #                once=<1: each selected frame fails at most once>
@@ -65,7 +87,8 @@ __all__ = ["FaultInjector", "FAULTS_GRAMMAR", "create_injector",
            "get_injector", "reset_injector"]
 
 _POINTS = ("element_raise", "fetch_drop", "reply_blackhole",
-           "dispatch_delay", "connection_drop", "replica_kill")
+           "dispatch_delay", "connection_drop", "replica_kill",
+           "process_kill", "broker_partition", "registrar_kill")
 
 # The spec grammar above as a declarative table over the shared
 # directive-grammar core (analyze/grammar.py): parse and offline lint
@@ -239,6 +262,34 @@ class FaultInjector:
         consuming the rule's ordinal (same determinism contract as
         element_raise)."""
         return self._fire("replica_kill", replica) is not None
+
+    # -- process-scoped points (the chaos harness) ---------------------
+
+    def process_kill(self, process) -> bool:
+        """Consume: should the whole process `process` die now?
+        ProcessManager consults once per monitor poll per OS child;
+        chaos harnesses consult once per tick per virtual process --
+        either way `frame=k` kills on the k-th consult for that node
+        (the node filter isolates each process's ordinal)."""
+        return self._fire("process_kill", process) is not None
+
+    def broker_partition(self, client) -> float:
+        """Consume: partition `client` from the broker?  Returns the
+        partition duration in SECONDS (0.0 = not fired; a fired rule
+        with no ms= means "until heal() is called").  Consulted by
+        LoopbackTransport once per publish when its `chaos_name` is
+        set, so `frame=k` partitions on the client's k-th publish."""
+        rule = self._fire("broker_partition", client)
+        if rule is None:
+            return 0.0
+        return rule.ms / 1000.0 if rule.ms > 0 else -1.0
+
+    def registrar_kill(self, registrar) -> bool:
+        """Consume: should the registrar `registrar` die now?  Same
+        shape as process_kill; a separate point so one chaos spec can
+        schedule gateway, replica, and registrar deaths independently
+        without sharing consumption ordinals."""
+        return self._fire("registrar_kill", registrar) is not None
 
     def stats(self) -> dict:
         with self._lock:

@@ -54,6 +54,19 @@ class GatewayTelemetry:
         self.warm_spawns = registry.counter("gateway.spawns_warm")
         self.cold_spawns = registry.counter("gateway.spawns_cold")
         self.last_time_to_healthy_ms: float | None = None
+        # crash consistency (serve/journal.py): HA takeovers and the
+        # journal's write/replay accounting -- `takeover_ms` is the
+        # recovery bound the chaos bench publishes (standby promote ->
+        # every journaled stream re-pinned)
+        self.takeovers = registry.counter("gateway.takeovers")
+        self.takeover_ms = registry.histogram("gateway.takeover_ms")
+        self.last_takeover_ms: float | None = None
+        self.journal_appends = registry.counter("gateway.journal_appends")
+        self.journal_entries = registry.gauge("gateway.journal_entries")
+        self.journal_replayed = registry.counter(
+            "gateway.journal_replayed")
+        self.journal_dropped_stale = registry.counter(
+            "gateway.journal_dropped_stale")
         self._interval = interval
         self._timer = None
         if self.enabled and interval > 0:
@@ -83,6 +96,13 @@ class GatewayTelemetry:
         self.last_time_to_healthy_ms = round(time_to_healthy_ms, 2)
         (self.warm_spawns if warm else self.cold_spawns).inc()
 
+    def record_takeover(self, takeover_ms: float) -> None:
+        """One HA takeover: standby promoted, journal adopted, streams
+        re-pinned."""
+        self.takeovers.inc()
+        self.takeover_ms.record(takeover_ms)
+        self.last_takeover_ms = round(takeover_ms, 2)
+
     def snapshot(self) -> dict:
         return self.registry.snapshot()
 
@@ -110,6 +130,20 @@ class GatewayTelemetry:
         if autoscaler is not None:
             summary["pool"] = self.gateway.pool_snapshot()
             summary["pending_spawns"] = autoscaler.pending
+        journal = getattr(self.gateway, "journal", None)
+        if journal is not None:
+            ha = {
+                "role": getattr(self.gateway, "role", "single"),
+                "backend": journal.backend.kind,
+                "journal_entries": self.journal_entries.value,
+                "journal_appends": self.journal_appends.value,
+                "replayed": self.journal_replayed.value,
+                "dropped_stale": self.journal_dropped_stale.value,
+                "takeovers": self.takeovers.value,
+            }
+            if self.last_takeover_ms is not None:
+                ha["takeover_ms"] = self.last_takeover_ms
+            summary["ha"] = ha
         return summary
 
     def _publish_snapshot(self) -> None:

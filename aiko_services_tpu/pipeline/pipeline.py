@@ -56,6 +56,11 @@ FUSED_FLAP_LIMIT = 3
 # dead-letter diagnostics are truncated: the topic carries evidence,
 # not payloads
 _DEAD_LETTER_DIAGNOSTIC_CAP = 500
+# dead letters embed the ENCODED inputs when they fit under this cap
+# (AIKO_DEAD_LETTER_DATA_MAX chars), so `aiko deadletter replay` can
+# re-submit the exact frame after a recovered outage; oversized frames
+# keep the descriptor-only shape (evidence, not payload)
+_DEAD_LETTER_DATA_CAP = 4096
 
 
 def _diagnostic_of(outputs) -> str:
@@ -1030,6 +1035,16 @@ class Pipeline(Actor):
         }
         descriptor = {str(key): self._describe_value(value)
                       for key, value in frame.swag.items()}
+        try:
+            import os as _os
+            cap = int(_os.environ.get("AIKO_DEAD_LETTER_DATA_MAX",
+                                      _DEAD_LETTER_DATA_CAP))
+            if cap > 0:
+                encoded = encode_frame_data(dict(frame.swag))
+                if len(encoded) <= cap:
+                    meta["data"] = encoded
+        except Exception:
+            pass  # unencodable swag: descriptor-only dead letter
         try:
             self.process.publish(
                 f"{self.topic_path}/dead_letter",

@@ -14,12 +14,13 @@ from .proxy import (                                        # noqa: F401
 from .share import (                                          # noqa: F401
     ECProducer, ECConsumer, ServicesCache,
     services_cache_create_singleton)
-from .registrar import Registrar                              # noqa: F401
+from .registrar import Registrar, RetainedElection            # noqa: F401
 from .state import StateMachine, StateMachineError            # noqa: F401
 from .process_manager import ProcessManager                   # noqa: F401
 from .compile_cache import (                                  # noqa: F401
     cache_stats, compile_cache_dir, disable_compile_cache,
     enable_compile_cache)
 from .lifecycle import LifeCycleManager, LifeCycleClient      # noqa: F401
-from .storage import Storage, do_command, do_request          # noqa: F401
+from .storage import (                                        # noqa: F401
+    KeyValueStore, Storage, do_command, do_request)
 from .recorder import Recorder                                # noqa: F401

@@ -105,6 +105,40 @@ class Process:
         self.connection.update_state(ConnectionState.NONE)
         self.event.terminate()
 
+    def crash(self) -> None:
+        """Abnormal death for the chaos harness (faults.py
+        process_kill / registrar_kill): NO service stop, NO clean
+        "(absent)" publish -- the transport severs (every registered
+        last-will fires, exactly as a broker reacts to a dead TCP
+        session) and the event loop halts mid-flight.  Survivors must
+        recover from the LWTs alone: the registrar reaps the services,
+        a gateway standby's election fires, journaled streams replay."""
+        _LOGGER.warning("%s: injected crash", self.topic_path_process)
+        transport = self.transport
+        sever = getattr(transport, "sever", None)
+        if sever is not None:
+            sever()
+        else:
+            transport.disconnect(send_lwt=True)
+        self.connection.update_state(ConnectionState.NONE)
+        self.event.terminate()
+
+    def rejoin(self) -> None:
+        """After a healed broker partition: reassert liveness (the
+        retained "(present)" the partition's LWT overwrote) and
+        re-register every service -- the registrar reaped them from
+        the "(absent)" notices while we were gone."""
+        self.publish(f"{self.topic_path_process}/0/state", "(present)",
+                     retain=True)
+        if (self.registrar
+                and self.connection.is_connected(ConnectionState.REGISTRAR)):
+            for service in self._services.values():
+                self._register_service(service.service_fields())
+        else:
+            # no primary in view: the bootstrap handshake re-registers
+            # everything when the next "(primary found ...)" arrives
+            self._pending_registrations = list(self._services.values())
+
     # -- services ----------------------------------------------------------
 
     def add_service(self, service) -> None:

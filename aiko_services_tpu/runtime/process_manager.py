@@ -124,7 +124,30 @@ class ProcessManager:
 
     def _monitor_loop(self) -> None:
         import time
+        from ..faults import get_injector
         while not self._terminated:
+            injector = get_injector()
+            if injector is not None:
+                # seeded chaos (faults.py process_kill): one consult
+                # per poll per child -- frame=k kills that child on
+                # its k-th poll, deterministically.  Disabled (the
+                # production state) this is one is-None check per poll
+                with self._lock:
+                    records = list(self.processes.items())
+                for process_id, record in records:
+                    if injector.process_kill(process_id):
+                        _LOGGER.warning(
+                            "Injected process_kill fired on %s",
+                            process_id)
+                        # SIGKILL without popping the record: the child
+                        # dies ABNORMALLY and the reap below observes
+                        # the exit, so process_exit_handler fires
+                        # exactly as for a real crash (kill() is the
+                        # deliberate-retirement path and suppresses it)
+                        try:
+                            record["process"].kill()
+                        except OSError:
+                            pass
             exited = []
             with self._lock:
                 for process_id, record in list(self.processes.items()):

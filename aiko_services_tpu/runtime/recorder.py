@@ -25,7 +25,7 @@ from __future__ import annotations
 from collections import deque
 
 from ..observe.metrics import merge_snapshots, parse_metrics_payload
-from ..utils import LRUCache, get_logger, parse
+from ..utils import LRUCache, generate, get_logger, parse
 from .actor import Actor
 from .share import ECProducer
 
@@ -132,6 +132,30 @@ class Recorder(Actor):
         """Newest-last (topic, meta, inputs-descriptor) tuples from the
         fleet's dead-letter topics."""
         return list(self.dead_letter_ring)
+
+    def deadletters(self, response_topic, count="64") -> None:
+        """Wire query for the dead-letter ring: `(deadletters
+        response_topic [count])` on /in answers with the Storage-style
+        paged shape -- "(item_count N)" then N "(item <json>)" records,
+        each {"index", "topic", "meta", "descriptor"} -- the surface
+        `aiko deadletter ls|replay` drains after a recovered outage.
+        Indexes are ring positions (newest last), stable between ls and
+        replay as long as no new dead letter lands between the two."""
+        try:
+            count = int(float(count))
+        except (TypeError, ValueError):
+            count = 64
+        entries = list(self.dead_letter_ring)
+        first = max(0, len(entries) - count)
+        publish = self.process.publish
+        import json
+        publish(response_topic,
+                generate("item_count", [len(entries) - first]))
+        for index in range(first, len(entries)):
+            topic, meta, descriptor = entries[index]
+            publish(response_topic, generate("item", [json.dumps(
+                {"index": index, "topic": topic, "meta": meta,
+                 "descriptor": descriptor})]))
 
     def metrics_sources(self) -> list:
         return list(self.metrics_snapshots.keys())

@@ -12,6 +12,13 @@
 # multi-secondary election bug (reference registrar.py:54-55); here a primary
 # that sees another primary's retained announcement with an EARLIER timestamp
 # deterministically demotes itself.
+#
+# The election machine itself is extracted as RetainedElection so OTHER
+# singletons ride the same proven protocol: the serving gateway's
+# hot-standby pair (serve/journal.py HA mode) elects its primary over a
+# retained "{namespace}/gateway/{group}" topic with exactly this state
+# machine -- one election implementation, one set of split-brain and
+# failover semantics, two consumers.
 
 from __future__ import annotations
 
@@ -23,49 +30,67 @@ from .service import (
     ServiceFields, ServiceFilter, Services, SERVICE_PROTOCOL_REGISTRAR)
 from .share import ECProducer
 
-__all__ = ["Registrar"]
+__all__ = ["Registrar", "RetainedElection"]
 
 _LOGGER = get_logger("registrar")
 _HISTORY_RING_SIZE = 4096  # reference registrar.py:128-129
 DEFAULT_SEARCH_TIMEOUT = 2.0  # reference registrar.py:139-141
 
 
-class Registrar(Actor):
-    def __init__(self, process, name: str = "registrar",
-                 search_timeout: float = DEFAULT_SEARCH_TIMEOUT):
-        super().__init__(process, name,
-                         protocol=SERVICE_PROTOCOL_REGISTRAR)
+class RetainedElection:
+    """Primary election over ONE retained bootstrap topic (the
+    registrar protocol, reference registrar.py:139-226):
+
+      start -> primary_search    subscribe, wait `search_timeout` for a
+                                 retained "(primary found ...)"
+      primary_search -> primary  nothing found: promote, set the LWT
+                                 "(primary absent)" (retained), announce
+      primary_search/secondary   a found record for ANOTHER topic path:
+        -> secondary             stand by
+      secondary -> primary_search a "(primary absent)" (the primary's
+                                 LWT fired, or a clean handover):
+                                 re-run the search at half timeout
+      primary -> secondary       a found record with an EARLIER
+                                 timestamp: deterministic demotion
+                                 (split-brain fix); ties break on the
+                                 LOWER topic path
+
+    The owner supplies `announce()` (publish the retained found record
+    -- its payload format is the owner's), and optional on_promote /
+    on_demote / on_state callbacks.  All transitions run on the
+    process event-loop thread (handlers + timers)."""
+
+    def __init__(self, process, boot_topic: str, topic_path: str,
+                 announce, search_timeout: float = DEFAULT_SEARCH_TIMEOUT,
+                 on_promote=None, on_demote=None, on_state=None,
+                 absent_payload: str = "(primary absent)"):
+        self.process = process
+        self.boot_topic = boot_topic
+        self.topic_path = topic_path
         self.search_timeout = search_timeout
-        self.command_aliases["share"] = "share_query"
+        self.absent_payload = absent_payload
+        self._announce = announce
+        self._on_promote = on_promote
+        self._on_demote = on_demote
+        self._on_state = on_state
         self.state = "start"
         self.time_started = epoch_now()
-        self.services_table = Services()
-        self.history_ring: deque = deque(maxlen=_HISTORY_RING_SIZE)
-        self.share.update({
-            "state": self.state,
-            "service_count": 0,
-            "time_started": repr(self.time_started),
-        })
-
-        self._boot_topic = process.topic_path_registrar_boot
-        self._state_pattern = f"{process.namespace}/+/+/+/state"
-        process.add_message_handler(self._boot_handler, self._boot_topic)
+        self._stopped = False
+        process.add_message_handler(self._boot_handler, boot_topic)
         self._transition("primary_search")
         process.event.add_timer_handler(
             self._search_timer, self.search_timeout)
 
-    # -- election ----------------------------------------------------------
-
     def _transition(self, state: str) -> None:
         self.state = state
-        if self.ec_producer:
-            self.ec_producer.update("state", state)
-        _LOGGER.debug("%s: state -> %s", self.topic_path, state)
+        if self._on_state is not None:
+            self._on_state(state)
+        _LOGGER.debug("%s: election state -> %s", self.topic_path, state)
 
     def _search_timer(self) -> None:
         self.process.event.remove_timer_handler(self._search_timer)
-        if self.state == "primary_search":
-            self._promote_to_primary()
+        if self.state == "primary_search" and not self._stopped:
+            self._promote()
 
     def _boot_handler(self, topic: str, payload: str) -> None:
         try:
@@ -88,10 +113,10 @@ class Registrar(Actor):
                     _LOGGER.warning(
                         "%s: older primary %s found, demoting",
                         self.topic_path, found_topic)
-                    self._demote_to_secondary()
+                    self._demote()
                 else:
                     # re-assert: we are the older primary
-                    self.process.announce_registrar(self.topic_path)
+                    self._announce()
             elif self.state in ("primary_search", "secondary"):
                 self._transition("secondary")
         elif parameters[0] == "absent":
@@ -100,20 +125,80 @@ class Registrar(Actor):
                 self.process.event.add_timer_handler(
                     self._search_timer, self.search_timeout * 0.5)
 
-    def _promote_to_primary(self) -> None:
+    def _promote(self) -> None:
         self.time_started = epoch_now()
         self._transition("primary")
-        transport = self.process.transport
-        transport.set_last_will_and_testament(
-            self._boot_topic, "(primary absent)", retain=True)
-        self.process.add_message_handler(
-            self._service_state_handler, self._state_pattern)
-        self.process.announce_registrar(self.topic_path)
+        self.process.transport.set_last_will_and_testament(
+            self.boot_topic, self.absent_payload, retain=True)
+        if self._on_promote is not None:
+            self._on_promote()
+        self._announce()
 
-    def _demote_to_secondary(self) -> None:
+    def _demote(self) -> None:
         self._transition("secondary")
         self.process.transport.clear_last_will_and_testament(
-            self._boot_topic)
+            self.boot_topic)
+        if self._on_demote is not None:
+            self._on_demote()
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self.state == "primary":
+            # clean handover: clear the retained announcement so the
+            # surviving secondary re-elects without waiting on an LWT
+            self.process.publish(self.boot_topic, self.absent_payload,
+                                 retain=True)
+        self.process.remove_message_handler(self._boot_handler,
+                                            self.boot_topic)
+
+
+class Registrar(Actor):
+    def __init__(self, process, name: str = "registrar",
+                 search_timeout: float = DEFAULT_SEARCH_TIMEOUT):
+        super().__init__(process, name,
+                         protocol=SERVICE_PROTOCOL_REGISTRAR)
+        self.search_timeout = search_timeout
+        self.command_aliases["share"] = "share_query"
+        self.services_table = Services()
+        self.history_ring: deque = deque(maxlen=_HISTORY_RING_SIZE)
+        self.share.update({
+            "state": "start",
+            "service_count": 0,
+            "time_started": repr(epoch_now()),
+        })
+
+        self._boot_topic = process.topic_path_registrar_boot
+        self._state_pattern = f"{process.namespace}/+/+/+/state"
+        self.election = RetainedElection(
+            process, self._boot_topic, self.topic_path,
+            announce=lambda: process.announce_registrar(self.topic_path),
+            search_timeout=search_timeout,
+            on_promote=self._on_promote, on_demote=self._on_demote,
+            on_state=self._on_state)
+
+    # -- election (RetainedElection drives the transitions) ----------------
+
+    @property
+    def state(self) -> str:
+        return self.election.state
+
+    @property
+    def time_started(self) -> float:
+        return self.election.time_started
+
+    def _on_state(self, state: str) -> None:
+        if self.ec_producer:
+            self.ec_producer.update("state", state)
+        _LOGGER.debug("%s: state -> %s", self.topic_path, state)
+
+    def _on_promote(self) -> None:
+        if self.ec_producer:
+            self.ec_producer.update("time_started",
+                                    repr(self.time_started))
+        self.process.add_message_handler(
+            self._service_state_handler, self._state_pattern)
+
+    def _on_demote(self) -> None:
         self.process.remove_message_handler(
             self._service_state_handler, self._state_pattern)
         self.services_table = Services()
@@ -181,13 +266,9 @@ class Registrar(Actor):
                 "service_count", len(self.services_table))
 
     def stop(self) -> None:
-        if self.state == "primary":
-            # clean handover: clear the retained announcement
-            self.process.publish(self._boot_topic, "(primary absent)",
-                                 retain=True)
-        self.process.remove_message_handler(self._boot_handler,
-                                            self._boot_topic)
-        if self.state == "primary":
+        was_primary = self.state == "primary"
+        self.election.stop()
+        if was_primary:
             self.process.remove_message_handler(
                 self._service_state_handler, self._state_pattern)
         super().stop()

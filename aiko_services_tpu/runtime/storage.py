@@ -6,11 +6,18 @@
 # generic invocation idioms -- do_command (discover a service by filter,
 # proxy, invoke) and do_request (command + paged "(item_count N)" response
 # collection on a dedicated response topic).
+#
+# The sqlite KV core is split out as KeyValueStore so non-actor layers
+# (the serving gateway's crash journal, serve/journal.py) persist
+# through the SAME backend without paying the wire: one schema, one
+# durability story, whether keys arrive over `/in` or from the gateway
+# tick.
 
 from __future__ import annotations
 
 import json
 import sqlite3
+import threading
 
 from ..utils import generate, get_logger, parse, parse_number
 from .actor import Actor
@@ -18,10 +25,113 @@ from .proxy import make_proxy
 from .service import ServiceFilter
 from .share import ServicesCache, services_cache_create_singleton
 
-__all__ = ["Storage", "do_command", "do_request"]
+__all__ = ["KeyValueStore", "Storage", "do_command", "do_request"]
 
 _LOGGER = get_logger("storage")
 SERVICE_PROTOCOL_STORAGE = "storage:0"
+
+
+class KeyValueStore:
+    """The sqlite key-value core shared by the Storage actor and the
+    gateway journal: JSON values under TEXT keys, with a batched
+    write path (`write_batch`: one transaction per journal tick, not
+    one commit per key) and prefix scans for replay."""
+
+    def __init__(self, database_path: str = ":memory:"):
+        self.database_path = database_path
+        self._connection = sqlite3.connect(
+            database_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._connection.execute(
+            "CREATE TABLE IF NOT EXISTS store "
+            "(key TEXT PRIMARY KEY, value TEXT)")
+        self._connection.commit()
+
+    def save(self, key, value) -> None:
+        with self._lock:
+            self._connection.execute(
+                "INSERT OR REPLACE INTO store (key, value) VALUES (?, ?)",
+                (str(key), json.dumps(value)))
+            self._connection.commit()
+
+    def write_batch(self, items: dict, deletes=()) -> None:
+        """Upserts + deletes in ONE transaction: a failure mid-batch
+        rolls back, so the store never holds a half-applied tick (an
+        unencodable value must not leave its batch-siblings pending on
+        the shared connection for the NEXT commit to sweep in)."""
+        with self._lock:
+            try:
+                for key, value in items.items():
+                    self._connection.execute(
+                        "INSERT OR REPLACE INTO store (key, value) "
+                        "VALUES (?, ?)", (str(key), json.dumps(value)))
+                for key in deletes:
+                    self._connection.execute(
+                        "DELETE FROM store WHERE key = ?", (str(key),))
+                self._connection.commit()
+            except Exception:
+                self._connection.rollback()
+                raise
+
+    def count(self, prefix: str = "") -> int:
+        with self._lock:
+            if prefix:
+                row = self._connection.execute(
+                    "SELECT COUNT(*) FROM store WHERE key LIKE ?",
+                    (prefix + "%",)).fetchone()
+            else:
+                row = self._connection.execute(
+                    "SELECT COUNT(*) FROM store").fetchone()
+        return int(row[0])
+
+    def load(self, key):
+        """Decoded value, or None when absent."""
+        with self._lock:
+            row = self._connection.execute(
+                "SELECT value FROM store WHERE key = ?",
+                (str(key),)).fetchone()
+        return None if row is None else json.loads(row[0])
+
+    def load_text(self, key) -> str | None:
+        """Stored JSON text (the Storage actor's wire unit)."""
+        with self._lock:
+            row = self._connection.execute(
+                "SELECT value FROM store WHERE key = ?",
+                (str(key),)).fetchone()
+        return None if row is None else row[0]
+
+    def delete(self, key) -> None:
+        with self._lock:
+            self._connection.execute(
+                "DELETE FROM store WHERE key = ?", (str(key),))
+            self._connection.commit()
+
+    def keys(self, prefix: str = "") -> list:
+        with self._lock:
+            if prefix:
+                rows = self._connection.execute(
+                    "SELECT key FROM store WHERE key LIKE ? ORDER BY key",
+                    (prefix + "%",)).fetchall()
+            else:
+                rows = self._connection.execute(
+                    "SELECT key FROM store ORDER BY key").fetchall()
+        return [row[0] for row in rows]
+
+    def items(self, prefix: str = "") -> list:
+        """[(key, decoded value)] sorted by key."""
+        with self._lock:
+            if prefix:
+                rows = self._connection.execute(
+                    "SELECT key, value FROM store WHERE key LIKE ? "
+                    "ORDER BY key", (prefix + "%",)).fetchall()
+            else:
+                rows = self._connection.execute(
+                    "SELECT key, value FROM store ORDER BY key").fetchall()
+        return [(key, json.loads(value)) for key, value in rows]
+
+    def close(self) -> None:
+        with self._lock:
+            self._connection.close()
 
 
 class Storage(Actor):
@@ -32,35 +142,21 @@ class Storage(Actor):
     def __init__(self, process, name: str = "storage",
                  database_path: str = ":memory:"):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_STORAGE)
-        self._connection = sqlite3.connect(
-            database_path, check_same_thread=False)
-        self._connection.execute(
-            "CREATE TABLE IF NOT EXISTS store "
-            "(key TEXT PRIMARY KEY, value TEXT)")
-        self._connection.commit()
+        self.store = KeyValueStore(database_path)
 
     def save(self, key, value) -> None:
-        self._connection.execute(
-            "INSERT OR REPLACE INTO store (key, value) VALUES (?, ?)",
-            (str(key), json.dumps(value)))
-        self._connection.commit()
+        self.store.save(key, value)
 
     def load(self, key, response_topic) -> None:
-        row = self._connection.execute(
-            "SELECT value FROM store WHERE key = ?",
-            (str(key),)).fetchone()
-        items = [] if row is None else [row[0]]  # stored JSON text
+        text = self.store.load_text(key)
+        items = [] if text is None else [text]  # stored JSON text
         self._respond(response_topic, items)
 
     def delete(self, key) -> None:
-        self._connection.execute(
-            "DELETE FROM store WHERE key = ?", (str(key),))
-        self._connection.commit()
+        self.store.delete(key)
 
     def keys(self, response_topic) -> None:
-        rows = self._connection.execute(
-            "SELECT key FROM store ORDER BY key").fetchall()
-        self._respond(response_topic, [row[0] for row in rows])
+        self._respond(response_topic, self.store.keys())
 
     def _respond(self, response_topic, items) -> None:
         """items are wire-ready strings (keys, or stored JSON text)."""
@@ -70,7 +166,7 @@ class Storage(Actor):
             publish(response_topic, generate("item", [item]))
 
     def stop(self) -> None:
-        self._connection.close()
+        self.store.close()
         super().stop()
 
 

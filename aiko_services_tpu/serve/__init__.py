@@ -3,13 +3,17 @@
 # SLO-aware shedding), least-loaded routing (power-of-two-choices over
 # registrar-discovered replicas' EC load gauges), bounded backpressure
 # with `(throttle ...)` signals to DataSources, mid-stream failover
-# that replays un-acknowledged frames on replica death, and an elastic
+# that replays un-acknowledged frames on replica death, an elastic
 # replica fleet (autoscale.py): watermark-driven scale up/down over the
 # lifecycle layer with warm-start replicas (persistent compile cache +
-# live sibling weight hand-off).  See README "Serving gateway" and
-# "Elastic scaling".
+# live sibling weight hand-off), and crash consistency (journal.py): a
+# write-ahead journal of routing state plus hot-standby election so a
+# gateway crash re-pins every stream exactly-once.  See README
+# "Serving gateway", "Elastic scaling", and "Crash recovery".
 
 from .policy import AdmissionPolicy, TokenBucket          # noqa: F401
+from .journal import (                                    # noqa: F401
+    GatewayJournal, JournalPolicy)
 from .gateway import Gateway, SERVICE_PROTOCOL_GATEWAY    # noqa: F401
 from .autoscale import (                                  # noqa: F401
     AutoScaler, InProcessReplicaFactory, ProcessReplicaFactory,

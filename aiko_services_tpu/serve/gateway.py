@@ -51,9 +51,11 @@ from ..faults import create_injector, get_injector
 from ..observe import GatewayTelemetry
 from ..pipeline.pipeline import DEFAULT_GRACE_TIME
 from ..pipeline.tensors import decode_frame_data, encode_frame_data
-from ..runtime import Actor, Lease, ServiceFilter
+from ..runtime import Actor, Lease, RetainedElection, ServiceFilter
 from ..runtime.service import PROTOCOL_PREFIX, SERVICE_PROTOCOL_PIPELINE
-from ..utils import generate, get_logger, parse, parse_float, parse_int
+from ..utils import (
+    epoch_now, generate, get_logger, parse, parse_float, parse_int)
+from .journal import GatewayJournal, JournalPolicy
 from .policy import AdmissionPolicy
 
 __all__ = ["Gateway", "SERVICE_PROTOCOL_GATEWAY"]
@@ -176,7 +178,8 @@ class _GatewayStream:
     __slots__ = ("stream_id", "priority", "slo_ms", "parameters",
                  "grace_time", "replica", "queue_response",
                  "topic_response", "throttle", "inflight", "delivered",
-                 "cursor", "parked", "throttled", "lease")
+                 "delivered_floor", "cursor", "parked", "throttled",
+                 "lease")
 
     def __init__(self, stream_id: str, priority: int, slo_ms: float,
                  parameters: dict, grace_time: float, replica: _Replica,
@@ -193,18 +196,27 @@ class _GatewayStream:
         # frame_id -> [frame_data, submitted_s, seq]: retained until the
         # response arrives so replica death can replay from the cursor
         self.inflight: dict[int, list] = {}
+        # exactly-once dedupe: every id <= delivered_floor has been
+        # delivered (the CONTIGUOUS prefix collapses into one int -- the
+        # journaled high-water mark), `delivered` holds the sparse ids
+        # above it
         self.delivered: set[int] = set()
+        self.delivered_floor = -1
         self.cursor = 0
         self.parked = 0               # this stream's parked-queue entries
         self.throttled = False
         self.lease: Lease | None = None
+
+    def is_delivered(self, frame_id: int) -> bool:
+        return (frame_id <= self.delivered_floor
+                or frame_id in self.delivered)
 
 
 class Gateway(Actor):
     def __init__(self, process, name: str = "gateway", policy=None,
                  router_seed: int = 0, faults=None, telemetry: bool = True,
                  metrics_interval: float = 10.0, autoscale=None,
-                 replica_factory=None):
+                 replica_factory=None, journal=None, ha=None):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
         # construction-time validation through the shared
         # directive-grammar core (analyze/grammar.py): a typo'd policy
@@ -238,11 +250,64 @@ class Gateway(Actor):
         self._services_cache = None
         self._discovery_handler = None
         self.autoscaler = None
+        # -- crash consistency (serve/journal.py): a journaled gateway
+        # rebuilds pins/cursors/dedupe floors after a crash; an HA
+        # group member additionally runs the registrar-style retained
+        # election and takes over when the primary's LWT fires
+        self.ha_group = str(ha) if ha else None
+        if self.ha_group and journal is None:
+            journal = ""          # HA implies journaled (retained mirror)
+        try:
+            self.journal_policy = (JournalPolicy.parse(journal)
+                                   if journal is not None else None)
+        except ValueError as error:
+            code = ("AIKO404" if getattr(error, "kind", "") == "unknown"
+                    else "AIKO407")
+            raise ValueError(
+                f"{code}: gateway journal policy rejected: "
+                f"{error}") from None
+        self.journal: GatewayJournal | None = None
+        self.election: RetainedElection | None = None
+        self.role = "single"
+        self._journal_dirty: set[str] = set()
+        self._journal_forgotten: set[str] = set()
+        self._buckets_dirty = False
+        self._journal_timer = None
+        self._takeover_started: float | None = None
+        if self.journal_policy is not None:
+            root = (f"{process.namespace}/gateway/"
+                    f"{self.ha_group or name}/journal")
+            self.journal = GatewayJournal(self.journal_policy, process,
+                                          root)
         self.share.update({
             "policy": self.policy.spec,
             "replica_count": 0,
             "stream_count": 0,
+            "role": self.role,
         })
+        self._ha_was_secondary = False
+        if self.ha_group:
+            self.role = "standby"
+
+            def note_state(state):
+                if state == "secondary":
+                    self._ha_was_secondary = True
+
+            self.election = RetainedElection(
+                process, f"{process.namespace}/gateway/{self.ha_group}",
+                self.topic_path, announce=self._announce_primary,
+                search_timeout=self.journal_policy.search_timeout_s,
+                on_promote=self._ha_promote, on_demote=self._ha_demote,
+                on_state=note_state)
+            self.share["role"] = self.role
+        elif self.journal is not None:
+            # restarted single gateway: adopt whatever the previous
+            # incarnation journaled, once replicas have had
+            # `replay_timeout` to (re)attach or be rediscovered
+            self._start_journal_tick()
+            self.post_message_later(
+                "_journal_recover", [],
+                self.journal_policy.replay_timeout_s)
         if autoscale is not None:
             self.enable_autoscale(autoscale, replica_factory)
 
@@ -290,6 +355,262 @@ class Gateway(Actor):
         if self.autoscaler is not None:
             self.autoscaler.spawn_finished(handle, info or {})
 
+    # -- crash consistency: journal + hot-standby election ------------------
+    #
+    # The journal records ROUTING state (pins, cursors, delivered
+    # floors, bucket levels), never frame payloads: after a takeover
+    # the client replays its un-acked frame DATA and the journaled
+    # dedupe floor guarantees exactly-once, exactly as replica
+    # failover's cursor replay does.  Batched per `interval` tick --
+    # the crash window is one tick, and anything younger is covered by
+    # the client-side replay.
+
+    def _announce_primary(self) -> None:
+        self.process.publish(
+            f"{self.process.namespace}/gateway/{self.ha_group}",
+            generate("primary", ["found", self.topic_path, "1",
+                                 repr(self.election.time_started)]),
+            retain=True)
+
+    def _ha_promote(self) -> None:
+        """Election won (cold start, or the primary's LWT fired): adopt
+        the journal, re-pin every live journaled stream through the
+        shared _migrate_streams path, start journaling."""
+        was_standby = self.role == "standby"
+        self.role = "primary"
+        self.share["role"] = self.role
+        if self.ec_producer is not None:
+            self.ec_producer.update("role", self.role)
+        started = time.monotonic()
+        adopted = self._adopt_journal()
+        self._start_journal_tick()
+        takeover_ms = (time.monotonic() - started) * 1000.0
+        if was_standby and self._ha_was_secondary:
+            # promotion after standing by = a real takeover (a cold
+            # start that never saw a primary is just a boot); the
+            # histogram records promote -> streams re-pinned
+            self.telemetry.record_takeover(takeover_ms)
+        _LOGGER.warning(
+            "%s: promoted to HA primary (%s); adopted %d journaled "
+            "stream(s) in %.1f ms", self.name, self.ha_group, adopted,
+            takeover_ms)
+        self._update_share()
+
+    def _ha_demote(self) -> None:
+        """An older primary exists (split-brain resolution): stop
+        journaling; existing streams keep serving but new clients will
+        follow the retained announcement to the real primary."""
+        self.role = "standby"
+        self.share["role"] = self.role
+        if self.ec_producer is not None:
+            self.ec_producer.update("role", self.role)
+        self._stop_journal_tick()
+        _LOGGER.warning("%s: demoted to HA standby (%s)", self.name,
+                        self.ha_group)
+
+    def _start_journal_tick(self) -> None:
+        if self.journal is None or self._journal_timer is not None:
+            return
+        interval = self.journal_policy.interval_s
+        if interval > 0:
+            self._journal_timer = self._journal_tick
+            self.process.event.add_timer_handler(self._journal_timer,
+                                                 interval)
+        else:
+            # interval=0: synchronous journaling (every mark flushes) --
+            # the deterministic mode chaos tests pin the crash window
+            # shut with
+            self._journal_timer = None
+
+    def _stop_journal_tick(self) -> None:
+        if self._journal_timer is not None:
+            self.process.event.remove_timer_handler(self._journal_timer)
+            self._journal_timer = None
+
+    def _mark_journal(self, stream: _GatewayStream) -> None:
+        if self.journal is None or self.role == "standby":
+            return
+        self._journal_dirty.add(stream.stream_id)
+        if self.journal_policy.interval_s <= 0:
+            self._journal_tick()
+
+    def _journal_forget(self, stream_id: str) -> None:
+        if self.journal is None or self.role == "standby":
+            return
+        self._journal_dirty.discard(stream_id)
+        self._journal_forgotten.add(stream_id)
+        if self.journal_policy.interval_s <= 0:
+            self._journal_tick()
+
+    def _journal_tick(self) -> None:
+        """One batched flush: serialize every dirty stream still
+        alive, delete the forgotten, refresh bucket levels."""
+        if self.journal is None or self.role == "standby":
+            return
+        if (not self._journal_dirty and not self._journal_forgotten
+                and not self._buckets_dirty):
+            return
+        records = {}
+        for stream_id in self._journal_dirty:
+            stream = self.streams.get(stream_id)
+            if stream is not None:
+                records[stream_id] = self._journal_record(stream)
+        forgotten = self._journal_forgotten
+        buckets = self._bucket_levels() if self._buckets_dirty else None
+        self._journal_dirty = set()
+        self._journal_forgotten = set()
+        self._buckets_dirty = False
+        written = self.journal.write(records, forgotten, buckets)
+        if written:
+            self.telemetry.journal_appends.inc(written)
+        self.telemetry.journal_entries.set(self.journal.entry_count())
+
+    def journal_flush(self) -> None:
+        """Force a journal tick NOW (deterministic tests/benches pin
+        the crash window shut before injecting a kill)."""
+        self._journal_tick()
+
+    def _journal_record(self, stream: _GatewayStream) -> dict:
+        parameters = stream.parameters
+        try:
+            json.dumps(parameters)
+        except (TypeError, ValueError):
+            # non-JSON-able local parameters: journal the stream's
+            # identity/cursor anyway (the pin survives; the new primary
+            # serves with replica-side parameters)
+            parameters = {}
+        return {
+            "stream_id": stream.stream_id,
+            "priority": stream.priority,
+            "slo_ms": stream.slo_ms,
+            "parameters": parameters,
+            "grace_time": stream.grace_time,
+            "topic_response": stream.topic_response or "",
+            "replica": (stream.replica.topic_path
+                        if stream.replica is not None else ""),
+            "cursor": stream.cursor,
+            "delivered_upto": stream.delivered_floor,
+            "expires_at": epoch_now() + max(stream.grace_time, 0.0),
+        }
+
+    def _bucket_levels(self) -> dict:
+        return {str(priority): round(bucket.tokens, 6)
+                for priority, bucket in self.policy.buckets.items()}
+
+    def _journal_recover(self) -> None:
+        """Mailbox continuation of the restart path (non-HA journaled
+        gateway): adopt after `replay_timeout` gave replicas time to
+        re-attach/rediscover."""
+        if self.role == "single":
+            adopted = self._adopt_journal()
+            if adopted:
+                _LOGGER.warning(
+                    "%s: restart recovery adopted %d journaled "
+                    "stream(s)", self.name, adopted)
+
+    def _journal_recover_retry(self) -> None:
+        """Deferred adoption retry: the pool was empty at promote/
+        restart time (full-outage cold start)."""
+        if self.journal is not None and self.role != "standby":
+            adopted = self._adopt_journal()
+            if adopted:
+                _LOGGER.warning(
+                    "%s: deferred recovery adopted %d journaled "
+                    "stream(s)", self.name, adopted)
+
+    def recover_now(self) -> int:
+        """Synchronous journal adoption (deterministic tests)."""
+        return self._adopt_journal()
+
+    def _adopt_journal(self) -> int:
+        """Rebuild gateway state from the journal: recreate each live
+        stream (cursor + dedupe floor restored), group them under
+        per-old-replica ghost pins, then run the SHARED zero-loss
+        migration path -- destroy on the old replica (fencing a
+        survivor that still serves the stream), re-pin on the current
+        pool, replay handled by client resubmission against the
+        restored floor.  Expired entries are dropped, never re-pinned
+        (journal.replay purges them)."""
+        if self.journal is None:
+            return 0
+        records, buckets, dropped = self.journal.replay()
+        if dropped:
+            self.telemetry.journal_dropped_stale.inc(dropped)
+        if records and not any(not replica.dead
+                               for replica in self.replicas.values()):
+            # cold start after a FULL outage: the pool is empty because
+            # rediscovery is still in flight, and adopting now would
+            # hard-fail (and forget) every journaled stream.  Wait one
+            # replay_timeout and try again -- record expiry bounds the
+            # retries, so a fleet that never comes back converges to an
+            # empty journal instead of looping forever
+            self._adopt_buckets(buckets)
+            _LOGGER.warning(
+                "%s: %d journaled stream(s) but no live replicas yet; "
+                "deferring adoption", self.name, len(records))
+            self.post_message_later(
+                "_journal_recover_retry", [],
+                max(self.journal_policy.replay_timeout_s, 0.05))
+            return 0
+        ghosts: dict[str, _Replica] = {}
+        adopted = 0
+        for record in records:
+            stream_id = str(record.get("stream_id", ""))
+            if not stream_id or stream_id in self.streams:
+                continue
+            old_topic = str(record.get("replica", "") or "")
+            ghost = ghosts.get(old_topic)
+            if ghost is None:
+                ghost = ghosts[old_topic] = _Replica(
+                    old_topic, f"journal:{old_topic or 'unpinned'}")
+                ghost.dead = True
+                live = self.replicas.get(old_topic)
+                if live is not None and live.pipeline is not None:
+                    # the old pin is a DIRECT-attached survivor: route
+                    # the fencing destroy through the same mailbox the
+                    # re-pin create uses, so the two cannot reorder
+                    ghost.pipeline = live.pipeline
+            try:
+                grace_time = float(record.get("grace_time",
+                                              DEFAULT_GRACE_TIME))
+            except (TypeError, ValueError):
+                grace_time = DEFAULT_GRACE_TIME
+            stream = _GatewayStream(
+                stream_id, parse_int(record.get("priority", 0), 0),
+                parse_float(record.get("slo_ms", 0.0), 0.0),
+                dict(record.get("parameters") or {}), grace_time, ghost,
+                topic_response=(record.get("topic_response") or None))
+            stream.cursor = parse_int(record.get("cursor", 0), 0)
+            stream.delivered_floor = parse_int(
+                record.get("delivered_upto", -1), -1)
+            stream.lease = Lease(
+                self.process.event, grace_time, stream_id,
+                lease_expired_handler=self._stream_lease_expired,
+                jitter=self._lease_jitter(stream_id))
+            self.streams[stream_id] = stream
+            ghost.streams.add(stream_id)
+            adopted += 1
+            self._journal_dirty.add(stream_id)  # re-journal the new pin
+        self._adopt_buckets(buckets)
+        for ghost in ghosts.values():
+            self._migrate_streams(ghost)
+        if adopted:
+            self.telemetry.journal_replayed.inc(adopted)
+            self._update_share()
+            self._journal_tick()
+        return adopted
+
+    def _adopt_buckets(self, levels: dict) -> None:
+        """Restore admission-bucket token levels: a rate-limited client
+        must not refill its budget by crashing the gateway."""
+        for key, tokens in (levels or {}).items():
+            bucket = self.policy.buckets.get(parse_int(key, -1))
+            if bucket is None:
+                continue
+            bucket.tokens = min(bucket.burst,
+                                max(0.0, parse_float(tokens, 0.0)))
+            bucket.updated = None
+
     def discover(self, service_filter: ServiceFilter = None,
                  **filter_kwargs) -> None:
         """Watch the registrar (via the process's shared ServicesCache)
@@ -325,7 +646,38 @@ class Gateway(Actor):
         consumer = ECConsumer(self.process, cache, fields.topic_path)
         replica = _Replica(fields.topic_path, fields.name,
                           consumer=consumer, cache=cache)
+        # liveness watch on the replica's PROCESS state topic: the LWT
+        # "(absent)" reaches us directly, registrar or no registrar.
+        # Discovery-remove alone has a hole the chaos harness exposed:
+        # a replica that dies DURING a registrar failover never
+        # re-registered with the new primary, so no remove ever fires
+        # -- its pinned streams would hang until stale_after.  The
+        # retained "(absent)" closes it (a late subscriber still sees
+        # the death).
+        self.process.add_message_handler(
+            self._replica_state_handler,
+            self._replica_state_topic(fields.topic_path))
         self._add_replica(replica)
+
+    @staticmethod
+    def _replica_state_topic(topic_path: str) -> str:
+        """{ns}/{host}/{pid}/{service_id} -> the owning process's
+        liveness topic {ns}/{host}/{pid}/0/state."""
+        return f"{topic_path.rsplit('/', 1)[0]}/0/state"
+
+    def _replica_state_handler(self, topic: str, payload: str) -> None:
+        try:
+            command, _ = parse(payload)
+        except ValueError:
+            return
+        if command != "absent":
+            return
+        process_root = topic.rsplit("/0/state", 1)[0]
+        for topic_path, replica in list(self.replicas.items()):
+            if (replica.consumer is not None
+                    and topic_path.rsplit("/", 1)[0] == process_root):
+                self.post_message("_replica_lost",
+                                  [topic_path, "process_absent"])
 
     def _add_replica(self, replica: _Replica) -> None:
         self.replicas[replica.topic_path] = replica
@@ -403,6 +755,9 @@ class Gateway(Actor):
             self._dead_letter_handler,
             f"{replica.topic_path}/dead_letter")
         if replica.consumer is not None:
+            self.process.remove_message_handler(
+                self._replica_state_handler,
+                self._replica_state_topic(replica.topic_path))
             replica.consumer.terminate()
 
     def _migrate_streams(self, replica: _Replica) -> None:
@@ -431,6 +786,7 @@ class Gateway(Actor):
             self.telemetry.failovers.inc()
             stream.replica = target
             target.streams.add(stream_id)
+            self._mark_journal(stream)   # the pin moved
             first = (min(stream.inflight) if stream.inflight
                      else stream.cursor)
             self._send_create(target, stream, first_frame_id=first)
@@ -515,10 +871,13 @@ class Gateway(Actor):
             return
         now = time.monotonic()
         bucket = self.policy.bucket_for(priority)
-        if bucket is not None and not bucket.try_take(now):
-            self._reject_stream(stream_id, "rate_limited",
-                                topic_response, queue_response)
-            return
+        if bucket is not None:
+            taken = bucket.try_take(now)
+            self._buckets_dirty = self.journal is not None
+            if not taken:
+                self._reject_stream(stream_id, "rate_limited",
+                                    topic_response, queue_response)
+                return
         replica = self._place(now)
         if replica is None:
             self._reject_stream(stream_id, "no_replica",
@@ -541,6 +900,7 @@ class Gateway(Actor):
         self.streams[stream_id] = stream
         replica.streams.add(stream_id)
         self.telemetry.admitted.inc()
+        self._mark_journal(stream)
         self._send_create(replica, stream)
         if self._throttle_on:
             # admitted INTO an active overload: this source starts
@@ -596,7 +956,7 @@ class Gateway(Actor):
         frame_id = (stream.cursor if frame_id is None else int(frame_id))
         if frame_id >= stream.cursor:
             stream.cursor = frame_id + 1
-        if frame_id in stream.delivered or frame_id in stream.inflight:
+        if stream.is_delivered(frame_id) or frame_id in stream.inflight:
             self.telemetry.duplicates.inc()
             return
         # SLO-aware shed: when the estimated queue wait already blows
@@ -611,6 +971,7 @@ class Gateway(Actor):
         seq = self._seq = self._seq + 1
         entry = [frame_data or {}, time.monotonic(), seq]
         stream.inflight[frame_id] = entry
+        self._mark_journal(stream)
         replica = stream.replica
         if (replica is not None and replica.has_capacity(self.policy)
                 and stream.parked == 0):
@@ -645,6 +1006,7 @@ class Gateway(Actor):
             replica.note_load(time.monotonic(), self.policy)
             self._send_destroy(replica, stream_id)
         stream.inflight.clear()
+        self._journal_forget(stream_id)
         self._update_share()
         self._drain_parked()
 
@@ -673,7 +1035,7 @@ class Gateway(Actor):
     def _send_destroy(self, replica: _Replica, stream_id: str) -> None:
         if replica.pipeline is not None:
             replica.pipeline.post_message("destroy_stream", [stream_id])
-        else:
+        elif replica.topic_path:
             self.process.publish(
                 f"{replica.topic_path}/in",
                 generate("destroy_stream", [stream_id]))
@@ -911,16 +1273,24 @@ class Gateway(Actor):
     def _frame_done(self, stream: _GatewayStream, frame_id: int,
                     outputs: dict, event=None) -> None:
         entry = stream.inflight.pop(frame_id, None)
-        if entry is None or frame_id in stream.delivered:
+        if entry is None or stream.is_delivered(frame_id):
             self.telemetry.duplicates.inc()
             return
         stream.delivered.add(frame_id)
+        # collapse the contiguous delivered prefix into the floor: the
+        # dedupe state a long-lived stream keeps is one int + the
+        # sparse out-of-order tail, and the floor is what the crash
+        # journal persists as the exactly-once high-water mark
+        while stream.delivered_floor + 1 in stream.delivered:
+            stream.delivered_floor += 1
+            stream.delivered.discard(stream.delivered_floor)
         if len(stream.delivered) > 8192:
-            # bounded: long-lived streams must not grow the dedupe set
-            # forever; ids far below the cursor can no longer recur
+            # bounded backstop for pathologically sparse delivery: ids
+            # far below the cursor can no longer recur
             floor = stream.cursor - 4096
             stream.delivered = {fid for fid in stream.delivered
                                 if fid >= floor}
+        self._mark_journal(stream)
         replica = stream.replica
         if replica is not None:
             replica.outstanding = max(0, replica.outstanding - 1)
@@ -991,6 +1361,7 @@ class Gateway(Actor):
             stream.lease.terminate()
             stream.lease = None
         self.streams.pop(stream.stream_id, None)
+        self._journal_forget(stream.stream_id)
         self._update_share()
 
     # -- observability -----------------------------------------------------
@@ -1021,6 +1392,7 @@ class Gateway(Actor):
         if self.ec_producer is not None:
             self.ec_producer.update("replica_count", len(self.replicas))
             self.ec_producer.update("stream_count", len(self.streams))
+            self.ec_producer.update("role", self.role)
 
     def stop(self) -> None:
         if self.autoscaler is not None:
@@ -1029,12 +1401,24 @@ class Gateway(Actor):
         self.telemetry.stop()
         for stream_id in list(self.streams):
             self.destroy_stream(stream_id)
+        if self.journal is not None:
+            # a CLEAN stop clears the journal (every stream destroyed
+            # above was forgotten): a later restart must not re-pin
+            # streams this incarnation deliberately tore down
+            self._journal_tick()
+            self._stop_journal_tick()
+            self.journal.stop()
+            self.journal = None
+        if self.election is not None:
+            # clean handover LAST: the retained "(primary absent)" lets
+            # a standby promote without waiting on our LWT, and it must
+            # not fire until teardown has settled the journal -- a
+            # standby racing our destroy loop could otherwise adopt
+            # records we are mid-way through forgetting
+            self.election.stop()
+            self.election = None
         for replica in list(self.replicas.values()):
-            self.process.remove_message_handler(
-                self._dead_letter_handler,
-                f"{replica.topic_path}/dead_letter")
-            if replica.consumer is not None:
-                replica.consumer.terminate()
+            self._detach_replica(replica)
         self.replicas.clear()
         if (self._services_cache is not None
                 and self._discovery_handler is not None):

@@ -154,6 +154,12 @@ class LoopbackTransport(Transport):
         # supports one will PER TOPIC so a process-liveness will and a
         # registrar-election will can coexist in one process.
         self.wills: dict[str, tuple[str, bool]] = {}
+        # chaos harness: name this client under the seeded
+        # `broker_partition` fault point (faults.py).  None (the
+        # default) costs one attribute check per publish
+        self.chaos_name: str | None = None
+        self._partitioned = False
+        self.partition_dropped = 0   # publishes lost to a partition
 
     def connect(self) -> None:
         self._broker = get_broker(self._broker_name)
@@ -178,10 +184,64 @@ class LoopbackTransport(Transport):
         consumers (ServicesCache, the serving gateway) must converge."""
         self.disconnect(send_lwt=True)
 
+    def partition(self) -> None:
+        """Broker partition: traffic drops in BOTH directions and the
+        broker -- having lost the client past its keepalive -- fires
+        the last-wills, exactly the >1.5x-keepalive cutoff shape a
+        real broker applies.  Unlike sever(), the CLIENT keeps its
+        subscriptions and wills, so heal() restores service (and the
+        process layer re-registers, Process.rejoin())."""
+        if self._partitioned:
+            return
+        self._partitioned = True
+        if self._broker is not None:
+            self._broker.detach(self, send_lwt=True)
+
+    def heal(self) -> None:
+        """End a partition: re-attach to the broker and replay retained
+        messages for every subscription (the reconnect contract)."""
+        if not self._partitioned:
+            return
+        self._partitioned = False
+        if self._broker is not None and self._connected:
+            self._broker.attach(self)
+            with self._lock:
+                patterns = list(self._subscriptions)
+            for pattern in patterns:
+                self._broker.deliver_retained(self, pattern)
+
+    @property
+    def partitioned(self) -> bool:
+        return self._partitioned
+
     def publish(self, topic: str, payload, retain: bool = False) -> None:
         if self._broker is None:
             raise RuntimeError("LoopbackTransport not connected")
+        if self.chaos_name is not None and not self._partitioned:
+            self._consult_partition_point()
+        if self._partitioned:
+            # a partitioned client's publishes die on the wire (QoS 0
+            # semantics); the counter is the reconcile evidence
+            self.partition_dropped += 1
+            return
         self._broker.publish(topic, payload, retain)
+
+    def _consult_partition_point(self) -> None:
+        """Seeded chaos: one `broker_partition` draw per publish
+        (faults.py; frame=k partitions on this client's k-th publish,
+        ms= schedules the heal)."""
+        from ..faults import get_injector
+        injector = get_injector()
+        if injector is None:
+            return
+        duration = injector.broker_partition(self.chaos_name)
+        if duration == 0.0:
+            return
+        self.partition()
+        if duration > 0:
+            timer = threading.Timer(duration, self.heal)
+            timer.daemon = True
+            timer.start()
 
     def subscribe(self, topic: str) -> None:
         with self._lock:

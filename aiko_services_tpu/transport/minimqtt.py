@@ -359,6 +359,21 @@ class Client:
         self._port = None
         self._keepalive = 60
         self._closing = False
+        # offline publish queue: during a broker outage publishes park
+        # here and replay on reconnect (after resubscription), instead
+        # of silently vanishing with rc=4.  BOUNDED -- a long outage
+        # under steady publish load must not grow memory without limit
+        # -- drop-OLDEST (the stalest state update is the least
+        # valuable), every drop counted on `mqtt.offline_dropped` so
+        # queued == replayed + dropped + len(pending) reconciles
+        import os as _os
+        try:
+            self._offline_max = int(
+                _os.environ.get("AIKO_MQTT_OFFLINE_MAX", 256))
+        except ValueError:
+            self._offline_max = 256
+        self._offline: list = []      # (topic, payload bytes, retain)
+        self._offline_lock = threading.Lock()
 
     # paho surface ----------------------------------------------------------
 
@@ -415,6 +430,9 @@ class Client:
     def publish(self, topic, payload=None, retain=False) -> int:
         data = (payload.encode("utf-8") if isinstance(payload, str)
                 else bytes(payload or b""))
+        if not self._connected.is_set() and not self._closing:
+            self._offline_enqueue(topic, data, retain)
+            return 0
         flags = 0x01 if retain else 0x00
         packet = _packet(PUBLISH, flags, _encode_string(topic) + data)
         result = self._send(packet)
@@ -422,7 +440,54 @@ class Client:
             metrics = get_registry()
             metrics.counter("mqtt.publish_count").inc()
             metrics.counter("mqtt.publish_bytes").inc(len(packet))
+        elif not self._closing:
+            # the socket died under us (outage starting): park it with
+            # the offline queue rather than dropping one message on the
+            # disconnect boundary
+            self._offline_enqueue(topic, data, retain)
+            result = 0
         return result
+
+    def _offline_enqueue(self, topic, data: bytes, retain: bool) -> None:
+        if self._offline_max <= 0:
+            get_registry().counter("mqtt.offline_dropped").inc()
+            return
+        with self._offline_lock:
+            self._offline.append((topic, data, retain))
+            dropped = len(self._offline) - self._offline_max
+            if dropped > 0:
+                del self._offline[:dropped]
+            else:
+                dropped = 0
+        metrics = get_registry()
+        metrics.counter("mqtt.offline_queued").inc()
+        if dropped:
+            metrics.counter("mqtt.offline_dropped").inc(dropped)
+
+    def _offline_flush(self) -> None:
+        """Replay parked publishes after a reconnect -- called AFTER
+        on_connect so subscriptions are restored first and replayed
+        state lands on a fully resubscribed session."""
+        with self._offline_lock:
+            pending, self._offline = self._offline, []
+        if not pending:
+            return
+        replayed = 0
+        for index, (topic, data, retain) in enumerate(pending):
+            flags = 0x01 if retain else 0x00
+            packet = _packet(PUBLISH, flags, _encode_string(topic) + data)
+            if self._send(packet) == 0:
+                replayed += 1
+            else:
+                # connection died again mid-flush: re-park the rest in
+                # order (ahead of anything queued meanwhile)
+                with self._offline_lock:
+                    self._offline = pending[index:] + self._offline
+                break
+        if replayed:
+            metrics = get_registry()
+            metrics.counter("mqtt.offline_replayed").inc(replayed)
+            metrics.counter("mqtt.publish_count").inc(replayed)
 
     def subscribe(self, topic) -> int:
         self._packet_id = (self._packet_id % 0xFFFF) + 1
@@ -576,7 +641,15 @@ class Client:
                 return
             packet_type, _flags_unused, body = packet
             if packet_type == CONNACK:
+                # replay the parked backlog BEFORE opening the direct
+                # publish path: a fresh publish racing the flush could
+                # otherwise hit the wire first and have a STALE parked
+                # retained value replayed over it.  Publishers during
+                # the first drain still park (not yet connected); the
+                # second drain picks those up after the gate opens
+                self._offline_flush()
                 self._connected.set()
+                self._offline_flush()
                 get_registry().counter("mqtt.connects").inc()
                 if self.on_connect is not None:
                     self.on_connect(self, None, None, 0, None)

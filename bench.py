@@ -1602,6 +1602,316 @@ def bench_autoscale(peak):
     }
 
 
+# -- chaos: the whole control plane under seeded process-level faults --------
+
+# one spec, three surfaces: the HA gateway pair's journal, the
+# definition parameter `aiko lint --bench` checks (AIKO407), and the
+# published config block
+_CHAOS_JOURNAL = "backend=retained;interval=0.02;search_timeout=0.5"
+
+
+def _chaos_definition(name):
+    """One deterministic integer element (x*3): the chaos scenario
+    measures RECOVERY, not compute, and integer outputs make the
+    bit-identical comparison exact by construction."""
+    return {
+        "name": name,
+        "parameters": {"telemetry": TELEMETRY, "metrics_interval": 60.0,
+                       "journal_policy": _CHAOS_JOURNAL},
+        "graph": ["(multiply)"],
+        "elements": [
+            {"name": "multiply",
+             "input": [{"name": "number", "type": "int"}],
+             "output": [{"name": "number", "type": "int"}],
+             "parameters": {"constant": 3},
+             "deploy": {"local": {"module": ELEMENTS,
+                                  "class_name": "PE_Multiply"}}},
+        ],
+    }
+
+
+def bench_chaos(peak, seed: int | None = None):
+    """`chaos` config: one seeded scenario kills the REGISTRAR primary,
+    a REPLICA, and the GATEWAY primary mid-run under open client load,
+    and proves the whole control plane recovers: the registrar
+    secondary promotes and re-registers the fleet (round-8 LWT reap),
+    the gateway migrates the dead replica's streams (PR-4 failover),
+    and the HA standby adopts the retained journal and resumes every
+    stream exactly-once (this round).  Two arms -- chaos and an
+    uncrashed reference -- must produce BIT-IDENTICAL per-frame
+    outputs with frames_lost == 0; published numbers are the
+    time-to-recover per event, the standby takeover latency, and the
+    registrar promote latency.  Runs entirely host-side (loopback
+    broker, virtual processes): the number is a robustness bound, not
+    a throughput figure."""
+    import threading
+
+    from aiko_services_tpu.faults import create_injector
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.pipeline.tensors import (
+        decode_frame_data, encode_frame_data)
+    from aiko_services_tpu.runtime import Process, Registrar
+    from aiko_services_tpu.serve import Gateway
+    from aiko_services_tpu.transport import reset_brokers
+    from aiko_services_tpu.utils import generate, parse
+
+    seed = int(os.environ.get("AIKO_CHAOS_SEED", "11")
+               if seed is None else seed)
+    streams_n = 4 if SMOKE else 8
+    per_stream = 25 if SMOKE else 50
+    total = streams_n * per_stream
+    # the three kills land at seeded fractions of the submission run:
+    # registrar first (so the replica kill is reaped by the PROMOTED
+    # primary), then the replica, then the gateway
+    kill_registrar = max(total // 4, 1)
+    kill_replica = max(total // 2, 2)
+    kill_gateway = max((3 * total) // 4, 3)
+    group = "chaos"
+
+    def wait(predicate, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.005)
+        raise TimeoutError("chaos fleet condition not met")
+
+    def run(chaos: bool):
+        processes = []
+
+        def make_process():
+            process = Process(transport_kind="loopback")
+            processes.append(process)
+            return process
+
+        registrar_1_process = make_process()
+        registrar_1 = Registrar(registrar_1_process, name="reg1",
+                                search_timeout=0.2)
+        registrar_1_process.run(in_thread=True)
+        wait(lambda: registrar_1.state == "primary")
+        registrar_2_process = make_process()
+        registrar_2 = Registrar(registrar_2_process, name="reg2",
+                                search_timeout=0.2)
+        registrar_2_process.run(in_thread=True)
+        wait(lambda: registrar_2.state == "secondary")
+        replicas = []
+        for index in range(2):
+            process = make_process()
+            replicas.append((process, create_pipeline(
+                process, _chaos_definition(f"chaos_replica{index}"))))
+            process.run(in_thread=True)
+
+        def make_gateway():
+            process = make_process()
+            gateway = Gateway(process, policy="max_inflight=16;queue=256",
+                              router_seed=seed, journal=_CHAOS_JOURNAL,
+                              ha=group, metrics_interval=60.0)
+            gateway.discover(name="chaos_replica*")
+            process.run(in_thread=True)
+            return gateway
+
+        gateway_a = make_gateway()
+        wait(lambda: gateway_a.role == "primary")
+        gateway_b = make_gateway()
+        wait(lambda: gateway_b.election.state == "secondary")
+        for gateway in (gateway_a, gateway_b):
+            wait(lambda: len(gateway.replicas) == 2 and all(
+                replica.consumer.last_update is not None
+                for replica in gateway.replicas.values()))
+
+        client_process = make_process()
+        reply_topic = (f"{client_process.topic_path_process}/0/"
+                       f"chaos_client")
+        lock = threading.Lock()
+        responses: dict = {}
+        response_times: list = []
+        primary = {"topic": gateway_a.topic_path}
+
+        def on_reply(topic, payload):
+            try:
+                command, parameters = parse(payload)
+            except ValueError:
+                return
+            if command != "process_frame_response" or not parameters:
+                return
+            reply = parameters[0]
+            if not isinstance(reply, dict) or reply.get("event"):
+                return
+            key = (str(reply.get("stream_id")),
+                   int(reply.get("frame_id", -1)))
+            outputs = (decode_frame_data(parameters[1])
+                       if len(parameters) > 1 else {})
+            now = time.perf_counter()
+            with lock:
+                if key not in responses:
+                    responses[key] = outputs.get("number")
+                    response_times.append((now, key))
+
+        def on_boot(topic, payload):
+            try:
+                command, parameters = parse(payload)
+            except ValueError:
+                return
+            if (command == "primary" and parameters
+                    and parameters[0] == "found" and len(parameters) > 1):
+                primary["topic"] = str(parameters[1])
+
+        client_process.add_message_handler(on_reply, reply_topic)
+        client_process.add_message_handler(
+            on_boot, f"{client_process.namespace}/gateway/{group}")
+        client_process.run(in_thread=True)
+        stream_ids = [f"c{index}" for index in range(streams_n)]
+
+        def create(stream_id):
+            client_process.publish(
+                f"{primary['topic']}/in",
+                generate("create_stream", [
+                    stream_id, json.dumps({}).encode("ascii"), 600.0,
+                    reply_topic]))
+
+        def submit(stream_id, frame_id):
+            client_process.publish(
+                f"{primary['topic']}/in",
+                generate("process_frame", [
+                    {"stream_id": stream_id, "frame_id": frame_id},
+                    encode_frame_data(
+                        {"number": frame_id}).encode("ascii")]))
+
+        injector = create_injector(
+            f"seed={seed};"
+            f"registrar_kill:node=reg1:frame={kill_registrar};"
+            f"process_kill:node=replica0:frame={kill_replica};"
+            f"process_kill:node=gateway_a:frame={kill_gateway}"
+        ) if chaos else None
+        events: list = []
+        start = time.perf_counter()
+
+        def chaos_tick():
+            """One seeded consult per submission per point -- the
+            deterministic chaos plan (faults.py process-scoped points,
+            exercised through Process.crash / transport sever)."""
+            if injector is None:
+                return
+            now = round(time.perf_counter() - start, 3)
+            if injector.registrar_kill("reg1"):
+                registrar_1_process.crash()
+                event = {"type": "registrar_kill", "target": "reg1",
+                         "at_s": now}
+                events.append(event)
+
+                def note_promote(event=event):
+                    t0 = time.perf_counter()
+                    while (registrar_2.state != "primary"
+                           and time.perf_counter() - t0 < 30):
+                        time.sleep(0.002)
+                    event["promote_ms"] = round(
+                        (time.perf_counter() - t0) * 1000, 1)
+
+                threading.Thread(target=note_promote,
+                                 daemon=True).start()
+            if injector.process_kill("replica0"):
+                replicas[0][0].crash()
+                events.append({"type": "replica_kill",
+                               "target": "chaos_replica0", "at_s": now})
+            if injector.process_kill("gateway_a"):
+                gateway_a.process.crash()
+                events.append({"type": "gateway_kill",
+                               "target": "gateway_a", "at_s": now})
+
+        try:
+            for stream_id in stream_ids:
+                create(stream_id)
+            cursors = {stream_id: 0 for stream_id in stream_ids}
+            for index in range(total):
+                stream_id = stream_ids[index % streams_n]
+                frame_id = cursors[stream_id]
+                cursors[stream_id] += 1
+                submit(stream_id, frame_id)
+                chaos_tick()
+                time.sleep(0.002)
+            # drain: the client replays un-acked frames against the
+            # CURRENT primary (the retained announce) until every
+            # frame is answered -- the exactly-once dedupe makes the
+            # replay idempotent
+            expected = {(stream_id, frame_id)
+                        for stream_id in stream_ids
+                        for frame_id in range(per_stream)}
+            deadline = time.monotonic() + (60 if SMOKE else 120)
+            resubmit_rounds = 0
+            while time.monotonic() < deadline:
+                with lock:
+                    missing = expected - set(responses)
+                if not missing:
+                    break
+                resubmit_rounds += 1
+                for stream_id in {key[0] for key in missing}:
+                    create(stream_id)   # idempotent re-assertion
+                for stream_id, frame_id in sorted(missing):
+                    submit(stream_id, frame_id)
+                time.sleep(0.4)
+            with lock:
+                got = dict(responses)
+                times = list(response_times)
+            for event in events:
+                after = [t for t, _ in times
+                         if t - start > event["at_s"]]
+                event["ttr_ms"] = (round(
+                    (min(after) - start - event["at_s"]) * 1000, 1)
+                    if after else None)
+            summary = (gateway_b if chaos
+                       else gateway_a).telemetry.summary()
+            return {
+                "outputs": got,
+                "events": events,
+                "frames_lost": len(expected) - len(got),
+                "resubmit_rounds": resubmit_rounds,
+                "takeover_ms": (gateway_b.telemetry.last_takeover_ms
+                                if chaos else None),
+                "injected": injector.stats() if injector else {},
+                "ha": summary.get("ha", {}),
+            }
+        finally:
+            for process in processes:
+                try:
+                    process.terminate()
+                except Exception:
+                    pass
+
+    reference = run(chaos=False)
+    reset_brokers()
+    chaotic = run(chaos=True)
+    reset_brokers()
+    bit_identical = chaotic["outputs"] == reference["outputs"]
+    result = {
+        "seed": seed,
+        "streams": streams_n,
+        "frames_total": total,
+        "frames_lost": chaotic["frames_lost"],
+        "frames_lost_reference": reference["frames_lost"],
+        "bit_identical_to_uncrashed": bit_identical,
+        "events": chaotic["events"],
+        "takeover_ms": chaotic["takeover_ms"],
+        "registrar_promote_ms": next(
+            (event.get("promote_ms") for event in chaotic["events"]
+             if event["type"] == "registrar_kill"), None),
+        "resubmit_rounds": chaotic["resubmit_rounds"],
+        "injected": chaotic["injected"],
+        "journal": chaotic["ha"],
+        "topology": ("registrar pair + 2 wire-discovered replicas + "
+                     "HA gateway pair, loopback broker"),
+    }
+    timeline_path = os.environ.get("AIKO_CHAOS_TIMELINE")
+    if timeline_path:
+        try:
+            with open(timeline_path, "w") as handle:
+                json.dump({key: value for key, value in result.items()
+                           if key != "outputs"}, handle, indent=2)
+            result["timeline_file"] = timeline_path
+        except OSError as error:
+            result["timeline_error"] = str(error)
+    return result
+
+
 # -- config 6b: continuous batching (decode/ engine) -------------------------
 
 def bench_continuous(peak):
@@ -1902,6 +2212,7 @@ def collect_definitions() -> dict:
              "autoscale_policy": _AUTOSCALE_POLICY},
             {"preset": det_preset, "micro_batch": serving_micro,
              "dtype": "float32" if SMOKE else "bfloat16"}),
+        "chaos": _chaos_definition("bench_chaos"),
         "tts": _tts_definition(
             "hello" if SMOKE else
             "the quick brown fox jumps over the lazy dog",
@@ -1928,6 +2239,8 @@ _SUMMARY_FIELDS = (
     ("latency", "p50_ms", "latency_p50_ms"),
     ("autoscale", "time_to_healthy_warm_ms", "tth_warm_ms"),
     ("autoscale", "warm_vs_cold_speedup", "warm_speedup"),
+    ("chaos", "frames_lost", "chaos_lost"),
+    ("chaos", "takeover_ms", "takeover_ms"),
     ("tts", "mfu", "tts_mfu"),
     ("pipeline_multimodal", "mfu", "headline_mfu"),
     ("pipeline_multimodal", "audio_realtime_factor", "audio_rt"),
@@ -2030,7 +2343,7 @@ def main() -> None:
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
                        "longcontext,serving,continuous,autoscale,"
-                       "latency,tts,pipeline")
+                       "chaos,latency,tts,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -2056,6 +2369,8 @@ def main() -> None:
         configs["router"] = bench_router(peak, router_replicas or 2)
     if "autoscale" in wanted:
         configs["autoscale"] = bench_autoscale(peak)
+    if "chaos" in wanted:
+        configs["chaos"] = bench_chaos(peak)
     if "latency" in wanted:
         configs["latency"] = bench_latency(peak)
     if "tts" in wanted:

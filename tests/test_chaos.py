@@ -1,0 +1,830 @@
+# Crash-consistent serving under process-level chaos (ISSUE 9): the
+# gateway journal (serve/journal.py) -- sqlite and retained backends,
+# AIKO407 grammar, compaction, stale cold-start -- hot-standby takeover
+# through the shared RetainedElection with bit-identical exactly-once
+# resumption, the process-scoped fault points (process_kill /
+# broker_partition / registrar_kill) through ProcessManager and
+# LoopbackTransport, the registrar-kill composition regression, the
+# minimqtt bounded offline publish queue, and the `aiko deadletter`
+# drain surface.
+
+import json
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu import faults as faults_module
+from aiko_services_tpu.faults import create_injector
+from aiko_services_tpu.observe import get_registry
+from aiko_services_tpu.pipeline import (
+    PipelineElement, StreamEvent, create_pipeline)
+from aiko_services_tpu.pipeline.tensors import (
+    decode_frame_data, encode_frame_data)
+from aiko_services_tpu.runtime import (
+    Process, ProcessManager, Recorder, Registrar)
+from aiko_services_tpu.serve import Gateway, GatewayJournal, JournalPolicy
+from aiko_services_tpu.transport import reset_brokers
+from aiko_services_tpu.transport.loopback import LoopbackTransport, get_broker
+from aiko_services_tpu.utils import epoch_now, generate, parse
+from helpers import wait_for
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    faults_module.reset_injector()
+    reset_brokers()
+    yield
+    faults_module.reset_injector()
+    reset_brokers()
+
+
+class Scale(PipelineElement):
+    """x -> x*10 (deterministic: takeover replay must be bit-identical)."""
+
+    def process_frame(self, stream, x):
+        return StreamEvent.OKAY, {"y": x * 10.0}
+
+
+def _replica_definition(name, parameters=None, element_parameters=None):
+    return {
+        "name": name,
+        "parameters": dict(parameters or {}),
+        "graph": ["(scale)"],
+        "elements": [
+            {"name": "scale", "input": [{"name": "x"}],
+             "output": [{"name": "y"}],
+             "parameters": dict(element_parameters or {}),
+             "deploy": {"local": {"module": "tests.test_chaos",
+                                  "class_name": "Scale"}}},
+        ],
+    }
+
+
+def _frame_data(value):
+    return {"x": np.ones((1, 2), np.float32) * value}
+
+
+class WireClient:
+    """Pipeline-protocol client over the broker: it outlives any
+    gateway death, re-targets the surviving primary, and resubmits
+    un-acked frames -- the client half of the exactly-once story."""
+
+    def __init__(self, name="client"):
+        self.process = Process(transport_kind="loopback")
+        self.topic = f"{self.process.topic_path_process}/0/{name}"
+        self.lock = threading.Lock()
+        self.responses: dict = {}   # (sid, fid) -> [(status, outputs)]
+        self.sheds: list = []
+        self.process.add_message_handler(self._on_reply, self.topic)
+        self.process.run(in_thread=True)
+
+    def _on_reply(self, topic, payload):
+        command, parameters = parse(payload)
+        if command == "process_frame_response" and parameters:
+            reply = parameters[0]
+            key = (str(reply.get("stream_id")),
+                   int(reply.get("frame_id", -1)))
+            if reply.get("event"):
+                entry = (str(reply["event"]), None)
+            else:
+                outputs = (decode_frame_data(parameters[1])
+                           if len(parameters) > 1 else {})
+                entry = ("ok", outputs)
+            with self.lock:
+                self.responses.setdefault(key, []).append(entry)
+        elif command == "overloaded" and parameters:
+            with self.lock:
+                self.sheds.append(tuple(parameters))
+
+    def create(self, gateway_topic, stream_id, parameters=None,
+               grace_time=60.0):
+        self.process.publish(
+            f"{gateway_topic}/in",
+            generate("create_stream", [
+                stream_id,
+                json.dumps(parameters or {}).encode("ascii"),
+                grace_time, self.topic]))
+
+    def submit(self, gateway_topic, stream_id, frame_id, value):
+        self.process.publish(
+            f"{gateway_topic}/in",
+            generate("process_frame", [
+                {"stream_id": stream_id, "frame_id": frame_id},
+                encode_frame_data(_frame_data(value)).encode("ascii")]))
+
+    def destroy(self, gateway_topic, stream_id):
+        self.process.publish(f"{gateway_topic}/in",
+                             generate("destroy_stream", [stream_id]))
+
+    def acked(self, keys):
+        with self.lock:
+            return all(key in self.responses for key in keys)
+
+    def outputs_map(self):
+        """{(sid, fid): bytes-of-y} for every ok response (asserting
+        single delivery)."""
+        result = {}
+        with self.lock:
+            for key, entries in self.responses.items():
+                assert len(entries) == 1, (
+                    f"{key} answered {len(entries)} times: exactly-once "
+                    f"violated")
+                status, outputs = entries[0]
+                if status == "ok":
+                    value = np.asarray(outputs["y"])
+                    result[key] = (value.dtype.str, value.tobytes())
+        return result
+
+    def stop(self):
+        self.process.terminate()
+
+
+def _ha_fleet(db_path, replicas_n=2, policy="max_inflight=8;queue=64",
+              journal_extra="", group="grp"):
+    """2 replicas + HA gateway pair (A primary, B standby) over one
+    loopback broker; synchronous journaling (interval=0) pins the
+    crash window shut so the scenario is deterministic."""
+    spec = f"interval=0;search_timeout=0.3{journal_extra}"
+    if db_path is not None:
+        spec += f";path={db_path}"
+    processes, replicas = [], []
+    for index in range(replicas_n):
+        process = Process(transport_kind="loopback")
+        processes.append(process)
+        replicas.append(create_pipeline(
+            process, _replica_definition(f"replica{index}")))
+        process.run(in_thread=True)
+    process_a = Process(transport_kind="loopback")
+    gateway_a = Gateway(process_a, policy=policy, router_seed=7,
+                        journal=spec, ha=group)
+    process_a.run(in_thread=True)
+    processes.append(process_a)
+    wait_for(lambda: gateway_a.role == "primary", timeout=10)
+    process_b = Process(transport_kind="loopback")
+    gateway_b = Gateway(process_b, policy=policy, router_seed=7,
+                        journal=spec, ha=group)
+    process_b.run(in_thread=True)
+    processes.append(process_b)
+    wait_for(lambda: gateway_b.election.state == "secondary", timeout=10)
+    for replica in replicas:
+        gateway_a.attach_replica(replica)
+        gateway_b.attach_replica(replica)
+    return gateway_a, gateway_b, replicas, processes
+
+
+# -- journal policy grammar (AIKO407) ----------------------------------------
+
+
+class TestJournalPolicy:
+    def test_grammar_and_defaults(self):
+        policy = JournalPolicy.parse(None)
+        assert policy.backend == ""
+        policy = JournalPolicy.parse("interval=0.2;backend=retained")
+        assert policy.interval_s == 0.2
+        policy = JournalPolicy.parse("path=/tmp/x.db")
+        assert policy.backend == "sqlite"
+
+    def test_construction_error_codes_match_offline_lint(self):
+        from aiko_services_tpu.analyze.policies import check_journal_policy
+        process = Process(transport_kind="loopback")
+        with pytest.raises(ValueError) as error:
+            Gateway(process, journal="backend=sqlite")
+        assert "AIKO407" in str(error.value)
+        problems = check_journal_policy("backend=sqlite")
+        assert problems and problems[0][0] == "AIKO407"
+        with pytest.raises(ValueError) as error:
+            Gateway(process, journal="backnd=retained")
+        assert "AIKO404" in str(error.value)
+        assert check_journal_policy("backnd=retained")[0][0] == "AIKO404"
+
+    def test_sqlite_requires_path_offline_and_online(self):
+        with pytest.raises(ValueError, match="requires path"):
+            JournalPolicy.parse("backend=sqlite")
+
+
+# -- journal store semantics -------------------------------------------------
+
+
+class TestJournalStore:
+    def _record(self, stream_id, expires_in, replica="ns/h/p/1"):
+        return {"stream_id": stream_id, "priority": 0, "slo_ms": 0.0,
+                "parameters": {}, "grace_time": 60.0,
+                "topic_response": "", "replica": replica, "cursor": 5,
+                "delivered_upto": 4,
+                "expires_at": epoch_now() + expires_in}
+
+    def test_sqlite_roundtrip_forget_and_stale_drop(self, tmp_path):
+        policy = JournalPolicy.parse(f"path={tmp_path / 'j.db'}")
+        journal = GatewayJournal(policy)
+        journal.write({"s1": self._record("s1", 60),
+                       "s2": self._record("s2", -1)},
+                      buckets={"0": 0.5})
+        assert journal.entry_count() == 2
+        live, buckets, dropped = journal.replay()
+        assert [record["stream_id"] for record in live] == ["s1"]
+        assert dropped == 1
+        assert buckets == {"0": 0.5}
+        # the stale entry was purged by replay
+        assert journal.entry_count() == 1
+        journal.write({}, forgotten=["s1"])
+        assert journal.entry_count() == 0
+        journal.stop()
+
+    def test_compaction_sweeps_expired_entries(self, tmp_path):
+        policy = JournalPolicy.parse(
+            f"path={tmp_path / 'j.db'};compact_every=2")
+        journal = GatewayJournal(policy)
+        journal.write({"live": self._record("live", 60),
+                       "stale": self._record("stale", -1)})
+        assert journal.entry_count() == 2
+        # second tick crosses compact_every: the sweep drops the
+        # expired entry without an explicit forget
+        journal.write({"live": self._record("live", 60)})
+        assert journal.compactions == 1
+        assert journal.compacted_entries == 1
+        assert journal.entry_count() == 1
+        journal.stop()
+
+
+# -- gateway restart / takeover ----------------------------------------------
+
+
+class TestGatewayRecovery:
+    def test_restart_recovers_streams_from_sqlite(self, tmp_path):
+        db_path = tmp_path / "gw.db"
+        replica_process = Process(transport_kind="loopback")
+        replica = create_pipeline(replica_process,
+                                  _replica_definition("replica0"))
+        replica_process.run(in_thread=True)
+        process_a = Process(transport_kind="loopback")
+        gateway_a = Gateway(process_a, journal=f"path={db_path};interval=0")
+        gateway_a.attach_replica(replica)
+        process_a.run(in_thread=True)
+        client = WireClient()
+        try:
+            client.create(gateway_a.topic_path, "s1")
+            for frame_id in range(3):
+                client.submit(gateway_a.topic_path, "s1", frame_id,
+                              frame_id)
+            wait_for(lambda: client.acked(
+                [("s1", fid) for fid in range(3)]), timeout=30)
+            process_a.crash()   # no clean stop: the journal survives
+
+            process_b = Process(transport_kind="loopback")
+            gateway_b = Gateway(process_b,
+                                journal=f"path={db_path};interval=0")
+            gateway_b.attach_replica(replica)
+            process_b.run(in_thread=True)
+            wait_for(lambda: gateway_b.telemetry.journal_replayed.value
+                     == 1, timeout=10)
+            assert "s1" in gateway_b.streams
+            recovered = gateway_b.streams["s1"]
+            assert recovered.cursor == 3
+            assert recovered.delivered_floor == 2
+            # duplicate of an acked frame: deduped; new frames serve
+            client.submit(gateway_b.topic_path, "s1", 2, 2)
+            for frame_id in range(3, 6):
+                client.submit(gateway_b.topic_path, "s1", frame_id,
+                              frame_id)
+            wait_for(lambda: client.acked(
+                [("s1", fid) for fid in range(3, 6)]), timeout=30)
+            assert gateway_b.telemetry.duplicates.value >= 1
+            outputs = client.outputs_map()   # asserts exactly-once
+            assert set(outputs) == {("s1", fid) for fid in range(6)}
+            process_b.terminate()
+        finally:
+            client.stop()
+            replica_process.terminate()
+
+    def test_clean_stop_clears_journal(self, tmp_path):
+        db_path = tmp_path / "gw.db"
+        replica_process = Process(transport_kind="loopback")
+        replica = create_pipeline(replica_process,
+                                  _replica_definition("replica0"))
+        replica_process.run(in_thread=True)
+        process_a = Process(transport_kind="loopback")
+        gateway_a = Gateway(process_a, journal=f"path={db_path};interval=0")
+        gateway_a.attach_replica(replica)
+        process_a.run(in_thread=True)
+        client = WireClient()
+        try:
+            client.create(gateway_a.topic_path, "s1")
+            client.submit(gateway_a.topic_path, "s1", 0, 1)
+            wait_for(lambda: client.acked([("s1", 0)]), timeout=30)
+            process_a.terminate()   # CLEAN stop destroys + forgets
+            journal = GatewayJournal(
+                JournalPolicy.parse(f"path={db_path}"))
+            assert journal.entry_count() == 0
+            journal.stop()
+        finally:
+            client.stop()
+            replica_process.terminate()
+
+    def test_full_outage_cold_start_defers_until_replicas_return(
+            self, tmp_path):
+        """A restart with journaled streams but an EMPTY pool (full
+        outage: rediscovery still in flight) must DEFER adoption, not
+        hard-fail and forget every stream."""
+        db_path = tmp_path / "gw.db"
+        journal = GatewayJournal(JournalPolicy.parse(f"path={db_path}"))
+        journal.write({"s1": {
+            "stream_id": "s1", "priority": 0, "slo_ms": 0.0,
+            "parameters": {}, "grace_time": 60.0, "topic_response": "",
+            "replica": "ns/old/1/1", "cursor": 2, "delivered_upto": 1,
+            "expires_at": epoch_now() + 60.0}})
+        journal.stop()
+        process = Process(transport_kind="loopback")
+        gateway = Gateway(
+            process,
+            journal=f"path={db_path};interval=0;replay_timeout=0.1")
+        process.run(in_thread=True)
+        replica_process = Process(transport_kind="loopback")
+        try:
+            # first recovery attempt fires with no replicas: deferred,
+            # record intact
+            time.sleep(0.3)
+            assert gateway.streams == {}
+            assert gateway.journal.entry_count() == 1
+            # the fleet comes back: the retry adopts and re-pins
+            replica = create_pipeline(replica_process,
+                                      _replica_definition("replica0"))
+            replica_process.run(in_thread=True)
+            gateway.attach_replica(replica)
+            wait_for(lambda: gateway.telemetry.journal_replayed.value
+                     == 1, timeout=10)
+            assert gateway.streams["s1"].delivered_floor == 1
+        finally:
+            process.terminate()
+            replica_process.terminate()
+
+    def test_stale_journal_cold_start_drops_expired(self, tmp_path):
+        db_path = tmp_path / "gw.db"
+        journal = GatewayJournal(JournalPolicy.parse(f"path={db_path}"))
+        journal.write({"dead": {
+            "stream_id": "dead", "priority": 0, "slo_ms": 0.0,
+            "parameters": {}, "grace_time": 0.1, "topic_response": "",
+            "replica": "ns/gone/1/1", "cursor": 9, "delivered_upto": 8,
+            "expires_at": epoch_now() - 5.0}})
+        journal.stop()
+        process = Process(transport_kind="loopback")
+        gateway = Gateway(process, journal=f"path={db_path};interval=0")
+        process.run(in_thread=True)
+        try:
+            wait_for(lambda:
+                     gateway.telemetry.journal_dropped_stale.value == 1,
+                     timeout=10)
+            assert gateway.streams == {}
+            assert gateway.telemetry.journal_replayed.value == 0
+            # the stale entry is purged, not re-pinned to a dead replica
+            assert gateway.journal.entry_count() == 0
+        finally:
+            process.terminate()
+
+    def test_hot_standby_takeover_bit_identical(self, tmp_path):
+        """Acceptance: seeded gateway-kill -- the standby takes over
+        from the journal, every pre-crash stream finishes, outputs are
+        bit-identical to an uncrashed run, zero frames lost, and the
+        duplicate resubmissions are absorbed exactly-once."""
+        streams = [f"s{index}" for index in range(3)]
+
+        def run(crash):
+            gateway_a, gateway_b, _, processes = _ha_fleet(
+                tmp_path / ("crash.db" if crash else "clean.db"),
+                group="grp-crash" if crash else "grp-clean")
+            client = WireClient()
+            try:
+                for stream_id in streams:
+                    client.create(gateway_a.topic_path, stream_id)
+                for stream_id in streams:
+                    for frame_id in range(5):
+                        client.submit(gateway_a.topic_path, stream_id,
+                                      frame_id, frame_id)
+                first = [(sid, fid) for sid in streams
+                         for fid in range(5)]
+                wait_for(lambda: client.acked(first), timeout=60)
+                takeover_ms = None
+                if crash:
+                    gateway_a.process.crash()
+                    # the takeover counter is recorded AFTER adoption
+                    # completes -- the externally visible "B is
+                    # primary" moment (the retained announce follows)
+                    wait_for(lambda:
+                             gateway_b.telemetry.takeovers.value == 1,
+                             timeout=10)
+                    assert gateway_b.role == "primary"
+                    assert (gateway_b.telemetry.journal_replayed.value
+                            == len(streams))
+                    takeover_ms = gateway_b.telemetry.last_takeover_ms
+                    assert takeover_ms is not None
+                    target = gateway_b
+                else:
+                    target = gateway_a
+                # the client replays its tail conservatively: frames
+                # 3..4 are already acked (the new primary must dedupe
+                # them), 5..9 are new
+                for stream_id in streams:
+                    for frame_id in range(3, 10):
+                        client.submit(target.topic_path, stream_id,
+                                      frame_id, frame_id)
+                rest = [(sid, fid) for sid in streams
+                        for fid in range(5, 10)]
+                wait_for(lambda: client.acked(rest), timeout=60)
+                if crash:
+                    # 2 duplicate resubmissions per stream, deduped
+                    assert (gateway_b.telemetry.duplicates.value
+                            == 2 * len(streams))
+                outputs = client.outputs_map()  # asserts exactly-once
+                return outputs, takeover_ms
+            finally:
+                client.stop()
+                for process in processes:
+                    process.terminate()
+
+        baseline, _ = run(crash=False)
+        reset_brokers()
+        recovered, takeover_ms = run(crash=True)
+        expected = {(sid, fid) for sid in streams for fid in range(10)}
+        assert set(baseline) == expected
+        assert set(recovered) == expected      # frames_lost == 0
+        assert recovered == baseline           # bit-identical
+        assert takeover_ms >= 0.0
+
+    def test_retained_backend_hot_mirror_takeover(self, tmp_path):
+        gateway_a, gateway_b, _, processes = _ha_fleet(
+            None, replicas_n=1, journal_extra=";backend=retained",
+            group="grp-ret")
+        client = WireClient()
+        try:
+            client.create(gateway_a.topic_path, "s1")
+            for frame_id in range(4):
+                client.submit(gateway_a.topic_path, "s1", frame_id,
+                              frame_id)
+            wait_for(lambda: client.acked(
+                [("s1", fid) for fid in range(4)]), timeout=30)
+            # the standby mirrors the retained journal continuously
+            wait_for(lambda: gateway_b.journal.entry_count() == 1,
+                     timeout=10)
+            gateway_a.process.crash()
+            wait_for(lambda: gateway_b.telemetry.takeovers.value == 1,
+                     timeout=10)
+            assert gateway_b.telemetry.journal_replayed.value == 1
+            for frame_id in range(4, 8):
+                client.submit(gateway_b.topic_path, "s1", frame_id,
+                              frame_id)
+            wait_for(lambda: client.acked(
+                [("s1", fid) for fid in range(4, 8)]), timeout=30)
+            outputs = client.outputs_map()
+            assert set(outputs) == {("s1", fid) for fid in range(8)}
+        finally:
+            client.stop()
+            for process in processes:
+                process.terminate()
+
+    def test_bucket_levels_survive_takeover(self, tmp_path):
+        """A rate-limited client must not refill its admission budget
+        by crashing the gateway: bucket token levels ride the journal."""
+        gateway_a, gateway_b, _, processes = _ha_fleet(
+            tmp_path / "bucket.db", replicas_n=1,
+            policy="max_inflight=8;queue=64;bucket:0=0.0001/1",
+            group="grp-bucket")
+        client = WireClient()
+        try:
+            client.create(gateway_a.topic_path, "s1")
+            client.submit(gateway_a.topic_path, "s1", 0, 0)
+            wait_for(lambda: client.acked([("s1", 0)]), timeout=30)
+            gateway_a.process.crash()
+            wait_for(lambda: gateway_b.telemetry.takeovers.value == 1,
+                     timeout=10)
+            tokens = gateway_b.policy.buckets[0].tokens
+            assert tokens < 1.0    # the spent token came back drained
+            client.create(gateway_b.topic_path, "fresh")
+            wait_for(lambda: any(shed[0] == "fresh"
+                                 and shed[-1] == "rate_limited"
+                                 for shed in client.sheds), timeout=10)
+        finally:
+            client.stop()
+            for process in processes:
+                process.terminate()
+
+
+# -- registrar chaos regression (satellite) ----------------------------------
+
+
+class TestRegistrarChaos:
+    def test_registrar_kill_composes_with_lwt_reap(self):
+        """Seeded registrar_kill mid-serving: the secondary promotes,
+        services re-register, the in-flight stream completes -- and a
+        replica crash AFTER the promotion is still reaped through the
+        round-8 LWT path by the NEW primary, failing the stream over
+        with zero loss."""
+        injector = create_injector("seed=11;registrar_kill:node=reg1:frame=0")
+        registrar_process_1 = Process(transport_kind="loopback")
+        registrar_1 = Registrar(registrar_process_1, name="reg1",
+                                search_timeout=0.1)
+        registrar_process_1.run(in_thread=True)
+        wait_for(lambda: registrar_1.state == "primary", timeout=10)
+        registrar_process_2 = Process(transport_kind="loopback")
+        registrar_2 = Registrar(registrar_process_2, name="reg2",
+                                search_timeout=0.1)
+        registrar_process_2.run(in_thread=True)
+        wait_for(lambda: registrar_2.state == "secondary", timeout=10)
+        processes = [registrar_process_1, registrar_process_2]
+        replicas = []
+        for index in range(2):
+            process = Process(transport_kind="loopback")
+            processes.append(process)
+            replicas.append((process, create_pipeline(
+                process, _replica_definition(
+                    f"replica{index}",
+                    parameters={"metrics_interval": 0.2}))))
+            process.run(in_thread=True)
+        gateway_process = Process(transport_kind="loopback")
+        processes.append(gateway_process)
+        gateway = Gateway(gateway_process,
+                          policy="max_inflight=4;queue=64",
+                          router_seed=7)
+        gateway.discover(name="replica*")
+        gateway_process.run(in_thread=True)
+        try:
+            wait_for(lambda: len(gateway.replicas) == 2, timeout=10)
+            wait_for(lambda: all(
+                replica.consumer.last_update is not None
+                for replica in gateway.replicas.values()), timeout=10)
+            responses = queue.Queue()
+            gateway.submit_stream("w", {}, queue_response=responses)
+            wait_for(lambda: "w" in gateway.streams, timeout=10)
+            got = {}
+
+            def drain(count):
+                for _ in range(count):
+                    _, frame_id, outputs, status = responses.get(
+                        timeout=30)
+                    assert status == "ok"
+                    got[frame_id] = float(np.asarray(outputs["y"])[0, 0])
+
+            for frame_id in range(3):
+                gateway.submit_frame("w", _frame_data(frame_id))
+            drain(3)
+            # the seeded point fires on its first consult for reg1
+            assert injector.registrar_kill("reg1")
+            registrar_process_1.crash()
+            wait_for(lambda: registrar_2.state == "primary", timeout=10)
+            # services re-register with the promoted primary
+            wait_for(lambda: len(registrar_2.services_table) >= 3,
+                     timeout=10)
+            # the in-flight stream keeps serving through the handover
+            for frame_id in range(3, 6):
+                gateway.submit_frame("w", _frame_data(frame_id))
+            drain(3)
+            # now crash the pinned replica: the PROMOTED registrar
+            # reaps it from the LWT "(absent)" and the gateway fails
+            # the stream over (round-8 reap + chaos compose)
+            owner_name = gateway.streams["w"].replica.name
+            owner_process = next(process for process, pipeline in replicas
+                                 if pipeline.name == owner_name)
+            for frame_id in range(6, 9):
+                gateway.submit_frame("w", _frame_data(frame_id))
+            owner_process.crash()
+            drain(3)
+            assert got == {frame_id: frame_id * 10.0
+                           for frame_id in range(9)}
+            wait_for(lambda: len(gateway.replicas) == 1, timeout=10)
+            assert gateway.telemetry.failovers.value == 1
+            assert injector.stats().get("registrar_kill") == 1
+        finally:
+            for process in processes:
+                process.terminate()
+
+
+# -- process-scoped fault points ---------------------------------------------
+
+
+class TestProcessFaultPoints:
+    def test_process_kill_consulted_by_process_manager(self, monkeypatch):
+        monkeypatch.setenv("AIKO_FAULTS",
+                           "seed=3;process_kill:node=victim:frame=0")
+        faults_module.reset_injector()
+        exits = []
+        manager = ProcessManager(
+            process_exit_handler=lambda pid, code: exits.append(
+                (pid, code)))
+        manager.spawn("victim", "-c",
+                      ["import time; time.sleep(30)"],
+                      use_interpreter=True)
+        try:
+            wait_for(lambda: exits, timeout=15)
+            process_id, return_code = exits[0]
+            assert process_id == "victim"
+            assert return_code != 0      # SIGKILL, not a clean exit
+            stats = faults_module.get_injector().stats()
+            assert stats.get("process_kill") == 1
+        finally:
+            manager.terminate(grace=2)
+
+    def test_broker_partition_point_drops_heals_and_fires_lwt(
+            self, monkeypatch):
+        monkeypatch.setenv(
+            "AIKO_FAULTS",
+            "seed=5;broker_partition:node=clientA:frame=2")
+        faults_module.reset_injector()
+        received = []
+        receiver = LoopbackTransport(
+            on_message=lambda topic, payload: received.append(
+                (topic, payload)))
+        receiver.connect()
+        receiver.subscribe("chaos/#")
+        transport = LoopbackTransport()
+        transport.connect()
+        transport.set_last_will_and_testament("chaos/lwt", "(absent)")
+        transport.chaos_name = "clientA"
+        transport.publish("chaos/m", "0")
+        transport.publish("chaos/m", "1")
+        transport.publish("chaos/m", "2")   # third publish: partition
+        get_broker().drain()
+        payloads = [payload for topic, payload in received
+                    if topic == "chaos/m"]
+        assert payloads == ["0", "1"]       # "2" died on the wire
+        assert ("chaos/lwt", "(absent)") in received
+        assert transport.partitioned
+        assert transport.partition_dropped == 1
+        # while partitioned, nothing flows either way
+        transport.publish("chaos/m", "3")
+        get_broker().drain()
+        assert transport.partition_dropped == 2
+        transport.heal()
+        transport.publish("chaos/m", "4")
+        get_broker().drain()
+        assert [payload for topic, payload in received
+                if topic == "chaos/m"] == ["0", "1", "4"]
+
+    def test_process_rejoin_reasserts_presence(self):
+        process = Process(transport_kind="loopback")
+        process.run(in_thread=True)
+        try:
+            state_topic = f"{process.topic_path_process}/0/state"
+            get_broker().drain()
+            process.transport.partition()
+            get_broker().drain()
+            assert get_broker().retained(state_topic) == "(absent)"
+            process.transport.heal()
+            process.rejoin()
+            get_broker().drain()
+            assert get_broker().retained(state_topic) == "(present)"
+        finally:
+            process.terminate()
+
+
+# -- minimqtt offline publish queue (satellite) ------------------------------
+
+
+class TestMinimqttOfflineQueue:
+    def test_outage_queue_bounded_drop_oldest_and_reconciled(
+            self, monkeypatch):
+        from aiko_services_tpu.transport.minimqtt import (
+            Client, MiniMqttBroker)
+        monkeypatch.setenv("AIKO_MQTT_OFFLINE_MAX", "4")
+        registry = get_registry()
+        queued_0 = registry.counter("mqtt.offline_queued").value
+        dropped_0 = registry.counter("mqtt.offline_dropped").value
+        replayed_0 = registry.counter("mqtt.offline_replayed").value
+        broker = MiniMqttBroker()
+        publisher = Client()
+        publisher.connect_async("127.0.0.1", broker.port, keepalive=5)
+        publisher.loop_start()
+        wait_for(lambda: publisher._connected.is_set(), timeout=10)
+        try:
+            broker.stop()
+            wait_for(lambda: not publisher._connected.is_set(),
+                     timeout=10)
+            # six publishes into a max-4 queue: the two OLDEST drop
+            for index in range(6):
+                publisher.publish("offline/t", f"m{index}", retain=True)
+            assert (registry.counter("mqtt.offline_queued").value
+                    - queued_0) == 6
+            assert (registry.counter("mqtt.offline_dropped").value
+                    - dropped_0) == 2
+            # the broker returns (fresh port; the paho surface retargets
+            # the reconnect loop) and the queue replays on CONNACK
+            broker2 = MiniMqttBroker()
+            publisher.connect_async("127.0.0.1", broker2.port,
+                                    keepalive=5)
+            try:
+                wait_for(lambda: publisher._connected.is_set(),
+                         timeout=20)
+                wait_for(lambda: (
+                    registry.counter("mqtt.offline_replayed").value
+                    - replayed_0) == 4, timeout=10)
+                # the newest survivor is the retained value: ordering
+                # held through the drop-oldest + replay cycle
+                publisher.flush()
+                assert broker2.retained.get("offline/t") == b"m5"
+                # reconcile: queued == replayed + dropped
+                queued = (registry.counter("mqtt.offline_queued").value
+                          - queued_0)
+                dropped = (registry.counter(
+                    "mqtt.offline_dropped").value - dropped_0)
+                replayed = (registry.counter(
+                    "mqtt.offline_replayed").value - replayed_0)
+                assert queued == replayed + dropped
+            finally:
+                broker2.stop()
+        finally:
+            publisher.loop_stop()
+
+
+# -- aiko deadletter ls|replay (satellite) -----------------------------------
+
+
+class TestDeadLetterCli:
+    def test_ls_and_replay_through_gateway(self, tmp_path):
+        from aiko_services_tpu.cli import (
+            fetch_dead_letters, replay_dead_letter)
+        registrar_process = Process(transport_kind="loopback")
+        Registrar(registrar_process, search_timeout=0.05)
+        registrar_process.run(in_thread=True)
+        recorder_process = Process(transport_kind="loopback")
+        recorder = Recorder(recorder_process)
+        recorder_process.run(in_thread=True)
+        replica_process = Process(transport_kind="loopback")
+        # frame 2 fails EXACTLY once (seeded transient): the dead
+        # letter embeds the encoded inputs, and the operator replay of
+        # the same frame succeeds
+        replica = create_pipeline(replica_process, _replica_definition(
+            "replica0",
+            parameters={"faults":
+                        "seed=5;element_raise:node=scale:frame=2:times=1"},
+            element_parameters={"on_error": "drop_frame"}))
+        replica_process.run(in_thread=True)
+        gateway_process = Process(transport_kind="loopback")
+        gateway = Gateway(gateway_process)
+        gateway.attach_replica(replica)
+        gateway_process.run(in_thread=True)
+        client = WireClient()
+        try:
+            client.create(gateway.topic_path, "s1")
+            for frame_id in range(4):
+                client.submit(gateway.topic_path, "s1", frame_id,
+                              frame_id)
+            wait_for(lambda: client.acked(
+                [("s1", fid) for fid in range(4)]), timeout=30)
+            with client.lock:
+                assert client.responses[("s1", 2)][0][0] == "error"
+            wait_for(lambda: recorder.dead_letters(), timeout=10)
+            records = fetch_dead_letters(client.process, wait=10.0)
+            assert len(records) == 1
+            meta = records[0]["meta"]
+            assert meta["stream_id"] == "s1"
+            assert int(meta["frame_id"]) == 2
+            assert meta["reason"] == "drop_frame"
+            assert meta.get("data")    # small frame: inputs embedded
+            # drain: destroy the errored stream, then replay the dead
+            # letter through the gateway under a fresh stream
+            client.destroy(gateway.topic_path, "s1")
+            wait_for(lambda: "s1" not in gateway.streams, timeout=10)
+            assert replay_dead_letter(client.process, records[0],
+                                      gateway.topic_path,
+                                      topic_response=client.topic)
+            wait_for(lambda: client.responses.get(("s1", 2))
+                     and client.responses[("s1", 2)][-1][0] == "ok",
+                     timeout=30)
+            with client.lock:
+                status, outputs = client.responses[("s1", 2)][-1]
+            assert np.allclose(np.asarray(outputs["y"]),
+                               np.ones((1, 2), np.float32) * 20.0)
+        finally:
+            client.stop()
+            for process in (gateway_process, replica_process,
+                            recorder_process, registrar_process):
+                process.terminate()
+
+
+# -- delivered-floor dedupe compaction ---------------------------------------
+
+
+class TestDeliveredFloor:
+    def test_contiguous_prefix_collapses_into_floor(self):
+        replica_process = Process(transport_kind="loopback")
+        replica = create_pipeline(replica_process,
+                                  _replica_definition("replica0"))
+        replica_process.run(in_thread=True)
+        gateway_process = Process(transport_kind="loopback")
+        gateway = Gateway(gateway_process)
+        gateway.attach_replica(replica)
+        gateway_process.run(in_thread=True)
+        try:
+            responses = queue.Queue()
+            gateway.submit_stream("s1", {}, queue_response=responses)
+            wait_for(lambda: "s1" in gateway.streams, timeout=10)
+            for frame_id in range(8):
+                gateway.submit_frame("s1", _frame_data(frame_id))
+            for _ in range(8):
+                assert responses.get(timeout=30)[3] == "ok"
+            stream = gateway.streams["s1"]
+            wait_for(lambda: stream.delivered_floor == 7, timeout=10)
+            assert stream.delivered == set()    # all collapsed
+        finally:
+            gateway_process.terminate()
+            replica_process.terminate()
