@@ -2,7 +2,8 @@
 #
 # Every finding carries a rule code (AIKO1xx graph/ports, AIKO2xx
 # shape/dtype flow, AIKO3xx element/actor safety, AIKO4xx policy
-# grammars), a severity, and a location (definition / element / port),
+# grammars, AIKO5xx profile-guided tuning), a severity, and a location
+# (definition / element / port),
 # so CI can diff reports across commits and operators can suppress a
 # rule by code (element or pipeline parameter `lint_ignore`).
 #
@@ -66,6 +67,12 @@ RULES = {
     "AIKO405": ("error", "invalid continuous-batching decode parameter"),
     "AIKO406": ("error", "invalid autoscale policy spec"),
     "AIKO407": ("error", "invalid gateway HA/journal policy spec"),
+    # -- AIKO5xx: profile-guided tuning (tune/) --------------------------
+    "AIKO501": ("error", "invalid tune SLO/directive spec"),
+    "AIKO502": ("warning", "tune recommendation not applicable to the "
+                           "definition"),
+    "AIKO503": ("info", "trace metadata absent or not joinable against "
+                        "the static graph"),
 }
 
 

@@ -16,7 +16,8 @@ from .grammar import Field
 __all__ = ["run_policy_pass", "check_gateway_policy",
            "check_autoscale_policy", "check_faults_spec",
            "check_journal_policy", "check_decode_parameters",
-           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS"]
+           "check_tune_spec", "FAULT_TOLERANCE_FIELDS",
+           "DECODE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -142,6 +143,15 @@ def check_journal_policy(spec) -> list:
     return problems
 
 
+def check_tune_spec(spec) -> list:
+    """(code, message) problems in a `tune` SLO/directive spec (the
+    operating point a definition pins for `aiko tune`): the shared
+    grammar core validates it offline as AIKO501, exactly as
+    SloSpec.parse would at tune time."""
+    from ..tune.slo import check_tune_spec as check
+    return check(spec)
+
+
 def check_autoscale_policy(spec) -> list:
     """(code, message) problems in an elastic-fleet autoscale spec.
     Same shape as check_gateway_policy: the per-directive grammar
@@ -199,5 +209,9 @@ def run_policy_pass(definition) -> AnalysisReport:
     journal_spec = (definition.parameters or {}).get("journal_policy")
     if journal_spec:
         for code, message in check_journal_policy(journal_spec):
+            report.add(Diagnostic(code, message, definition=name))
+    tune_spec = (definition.parameters or {}).get("tune")
+    if tune_spec:
+        for code, message in check_tune_spec(tune_spec):
             report.add(Diagnostic(code, message, definition=name))
     return report

@@ -20,7 +20,7 @@ from __future__ import annotations
 from .diagnostics import AnalysisReport, Diagnostic
 from .specs import resolve_dims
 
-__all__ = ["run_eval_pass"]
+__all__ = ["run_eval_pass", "element_cost_estimates"]
 
 
 def _synthesize(spec, bindings: dict, default_size: int):
@@ -92,27 +92,56 @@ def _compare(report, definition_name, element_name, port_name,
             port=str(port_name)))
 
 
-def _trace_element(report, definition, element_def, element, input_specs,
-                   output_specs, bindings, default_size) -> None:
+def _instantiate_element(element_def, process):
+    """Instantiate a LOCAL element for shape tracing; None when the
+    deploy target is not a PipelineElement (AIKO304 is the actor
+    pass's finding).  Shared by the eval pass and the tune cost
+    estimates so the two can never drift."""
+    from ..pipeline.element import PipelineElement
+    from ..utils import load_module
+
+    module = load_module(element_def.deploy_local["module"])
+    cls = getattr(module, element_def.deploy_local["class_name"])
+    if not (isinstance(cls, type)
+            and issubclass(cls, PipelineElement)):
+        return None
+    return cls(process, None, element_def)
+
+
+def _kernel_structs(element, input_specs, bindings, default_size):
+    """(kernel, state_struct, input structs) from the element's
+    eval_kernel contract and its declared input specs -- or None when
+    the element has no pure program, or an input is opaque (str
+    prompts, "any") / un-pinned on an inner axis and cannot be
+    synthesized faithfully (skipped, not a finding: declare concrete
+    tensor specs to opt the element in)."""
     import jax
 
     kernel_spec = element.eval_kernel()
     if kernel_spec is None:
-        return
+        return None
     kernel, state_fn = kernel_spec
     structs = {}
     for port_name, spec in input_specs.items():
         shape = _synthesize(spec, bindings, default_size)
         if shape is None:
-            # opaque input (str prompts, "any") or un-pinned inner
-            # sizes: the kernel cannot be driven faithfully from the
-            # declared specs -- skipped, not a finding (declare
-            # concrete tensor specs to opt the element in)
-            return
+            return None
         structs[port_name] = jax.ShapeDtypeStruct(
             shape, jax.numpy.dtype(spec.dtype))
     state_struct = (jax.eval_shape(state_fn)
                     if state_fn is not None else None)
+    return kernel, state_struct, structs
+
+
+def _trace_element(report, definition, element_def, element, input_specs,
+                   output_specs, bindings, default_size) -> None:
+    import jax
+
+    resolved = _kernel_structs(element, input_specs, bindings,
+                               default_size)
+    if resolved is None:
+        return
+    kernel, state_struct, structs = resolved
     traced = jax.eval_shape(kernel, state_struct, **structs)
     if not isinstance(traced, dict):
         report.add(Diagnostic(
@@ -134,6 +163,97 @@ def _trace_element(report, definition, element_def, element, input_specs,
     report.traced_elements.append(element_def.name)
 
 
+def _struct_bytes(tree) -> int:
+    """Total bytes of every array leaf in an eval_shape result."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        size = 1
+        for dim in shape:
+            size *= int(dim)
+        total += size * jax.numpy.dtype(dtype).itemsize
+    return total
+
+
+def element_cost_estimates(definition, include_flops: bool = True,
+                           default_symbol_size: int = 2) -> dict:
+    """Static FLOP/byte estimates per element, the analyze/ half of the
+    tune/ cost model: for every local element with a pure device
+    program (the eval_kernel contract), synthesize ShapeDtypeStructs
+    from the declared port specs and measure -- WITHOUT running the
+    kernel -- bytes in/out/parameters (jax.eval_shape) and, when
+    `include_flops`, the XLA flop estimate from lowering the kernel
+    (Lowered.cost_analysis; skipped silently where the backend does
+    not report it).
+
+    Returns {element_name: {"rows", "bytes_in", "bytes_out",
+    "param_bytes", "flops"}}; elements that cannot be traced are
+    absent (the tune report marks them estimate-free rather than
+    guessing)."""
+    import jax
+
+    from ..runtime import Process
+    from .graph_flow import run_graph_pass
+
+    graph_report = run_graph_pass(definition)
+    input_specs = getattr(graph_report, "input_specs", {}) or {}
+    bindings = dict(getattr(graph_report, "symbol_bindings", {}) or {})
+    estimates: dict = {}
+    process = Process(transport_kind="null")
+    try:
+        for element_def in definition.elements:
+            if not element_def.is_local:
+                continue
+            try:
+                element = _instantiate_element(element_def, process)
+                if element is None:
+                    continue
+                resolved = _kernel_structs(
+                    element, input_specs.get(element_def.name, {}),
+                    bindings, default_symbol_size)
+                if resolved is None or not resolved[2]:
+                    continue
+                kernel, state_struct, structs = resolved
+                rows = None
+                for struct in structs.values():
+                    if struct.shape:
+                        rows = int(struct.shape[0])
+                        break
+                traced = jax.eval_shape(kernel, state_struct, **structs)
+                record = {
+                    "rows": rows or 1,
+                    "bytes_in": _struct_bytes(structs),
+                    "bytes_out": _struct_bytes(traced),
+                    "param_bytes": _struct_bytes(state_struct),
+                    "flops": None,
+                }
+                if include_flops:
+                    try:
+                        lowered = jax.jit(kernel).lower(
+                            state_struct, **structs)
+                        analysis = lowered.cost_analysis()
+                        if isinstance(analysis, (list, tuple)):
+                            analysis = analysis[0] if analysis else {}
+                        flops = (analysis or {}).get("flops")
+                        if flops is not None:
+                            record["flops"] = float(flops)
+                    except Exception:
+                        pass  # backend without cost analysis
+                estimates[element_def.name] = record
+            except Exception:
+                continue  # uninstantiable element: no estimate
+    finally:
+        try:
+            process.terminate()
+        except Exception:
+            pass
+    return estimates
+
+
 def run_eval_pass(definition, input_specs, output_specs,
                   symbol_bindings=None,
                   default_symbol_size: int = 2) -> AnalysisReport:
@@ -143,9 +263,7 @@ def run_eval_pass(definition, input_specs, output_specs,
     `input_specs`/`output_specs` are the per-element {port: PortSpec}
     maps the graph pass resolved; `symbol_bindings` its symbol table
     (shared so the whole graph traces under ONE binding)."""
-    from ..pipeline.element import PipelineElement
     from ..runtime import Process
-    from ..utils import load_module
 
     report = AnalysisReport(passes_run=["eval"])
     report.traced_elements = []
@@ -156,13 +274,9 @@ def run_eval_pass(definition, input_specs, output_specs,
             if not element_def.is_local:
                 continue
             try:
-                module = load_module(element_def.deploy_local["module"])
-                cls = getattr(module,
-                              element_def.deploy_local["class_name"])
-                if not (isinstance(cls, type)
-                        and issubclass(cls, PipelineElement)):
+                element = _instantiate_element(element_def, process)
+                if element is None:
                     continue  # AIKO304 is the actor pass's finding
-                element = cls(process, None, element_def)
             except Exception as error:
                 report.add(Diagnostic(
                     "AIKO208",

@@ -422,6 +422,229 @@ def deadletter_replay(index: int, gateway: str | None,
 
 
 @main.command()
+@click.argument("trace", type=click.Path(exists=True))
+@click.option("--slo", default="throughput",
+              help="SLO directive: 'throughput', 'latency', or a "
+                   "spec like 'slo=throughput;p99_ms=250' "
+                   "(AIKO501 grammar)")
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable report (byte-deterministic: the "
+                   "same trace + spec always renders identically)")
+@click.option("--output", default=None, type=click.Path(),
+              help="Also write the report to this file")
+@click.option("--definition", "definition_path", default=None,
+              type=click.Path(exists=True),
+              help="Side-channel definition for metadata-absent "
+                   "traces (self-describing traces embed their own)")
+@click.option("--run", "run_name", default=None,
+              help="Pick one run out of a combined multi-pipeline "
+                   "trace artifact")
+@click.option("--apply", "apply_path", default=None,
+              type=click.Path(),
+              help="Write the tuned definition document here (the "
+                   "recommendations applied, then re-linted; lint "
+                   "errors fail the command)")
+@click.option("--what-if", "what_if", default=None,
+              help="Re-score the trace under explicit settings "
+                   "instead of recommending: "
+                   "'asr.micro_batch=4;frame_window=8;replicas=2'")
+@click.option("--no-flops", "no_flops", is_flag=True,
+              help="Skip the static FLOP/byte estimation (no element "
+                   "instantiation -- faster; achieved-utilization "
+                   "evidence is omitted)")
+def tune(trace, slo, as_json, output, definition_path, run_name,
+         apply_path, what_if, no_flops) -> None:
+    """Profile-guided pipeline optimizer: classify each element's
+    dominant floor (dispatch / compute / queue / compile-bound) from a
+    recorded trace joined against the static graph, recommend concrete
+    settings for the stated SLO, and what-if replay them -- no
+    hardware needed (tune/ subsystem, README "Performance tuning").
+
+    TRACE is a Perfetto artifact from `bench.py --trace` or
+    PipelineTelemetry.export_trace.  Exit status: 0 report produced,
+    1 --apply produced a definition that fails lint, 2 the trace
+    cannot be joined (no metadata and no --definition).
+    """
+    import sys
+    from pathlib import Path
+
+    from .analyze.grammar import GrammarError
+    from .tune import (
+        SloSpec, TraceLoadError, render_report, report_json, run_tune)
+
+    if what_if is not None and apply_path is not None:
+        # --what-if scores EXPLICIT settings (no recommender), so
+        # there is nothing to apply -- silently ignoring --apply
+        # would hand a success exit code and no output file
+        click.echo("--what-if and --apply are mutually exclusive: "
+                   "what-if scores explicit settings without "
+                   "producing recommendations to apply", err=True)
+        sys.exit(2)
+    try:
+        slo_spec = SloSpec.parse(slo)
+    except GrammarError as error:
+        click.echo(f"bad --slo spec: {error}", err=True)
+        sys.exit(2)
+    static_costs = {} if no_flops else None
+    loaded = None
+    try:
+        if what_if is not None:
+            report = _tune_what_if(trace, slo_spec, definition_path,
+                                   run_name, what_if,
+                                   static_costs=static_costs)
+        else:
+            if apply_path is not None:
+                # one parse serves both the report and the apply
+                from .tune import load_trace
+                loaded = load_trace(trace, definition=definition_path,
+                                    run=run_name)
+            report = run_tune(trace, slo_spec=slo_spec,
+                              definition=definition_path,
+                              run=run_name,
+                              static_costs=static_costs,
+                              loaded=loaded)
+    except TraceLoadError as error:
+        click.echo(str(error), err=True)
+        sys.exit(2)
+    if not report.get("pipeline") and what_if is None:
+        # nothing joined: the trace carries spans but no definition
+        # (metadata absent and no side channel, or an ambiguous
+        # combined artifact) -- fail loudly instead of printing floors
+        # that cannot be attributed to typed nodes
+        for diagnostic in report.get("diagnostics", []):
+            click.echo(f"{diagnostic['code']}: "
+                       f"{diagnostic['message']}", err=True)
+        click.echo("trace not joined to a definition: give "
+                   "--definition for a metadata-absent trace (or "
+                   "--run for a combined one)", err=True)
+        sys.exit(2)
+    rendered = (report_json(report) if as_json
+                else render_report(report))
+    click.echo(rendered)
+    if output:
+        Path(output).write_text(
+            rendered if rendered.endswith("\n") else rendered + "\n")
+    if apply_path is not None and what_if is None:
+        sys.exit(_tune_apply(loaded, report, apply_path))
+
+
+_WHAT_IF_ELEMENT_KNOBS = ("micro_batch", "decode_slots",
+                          "kv_block_size")
+_WHAT_IF_PIPELINE_KNOBS = ("frame_window", "replicas")
+
+
+def _parse_what_if(spec: str, element_names) -> dict:
+    """'element.knob=value;knob=value' -> replay overrides.  Unknown
+    elements/knobs are usage errors: a typo'd override would
+    otherwise be silently ignored and the what-if replay would print
+    baseline numbers as the proposed score."""
+    overrides: dict = {"elements": {}}
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        try:
+            number = int(value)
+        except ValueError:
+            raise click.ClickException(
+                f"--what-if value {value!r} is not an integer "
+                f"(in {part!r})")
+        if "." in key:
+            element, knob = (token.strip()
+                             for token in key.split(".", 1))
+            if element not in element_names:
+                raise click.ClickException(
+                    f"--what-if names unknown element {element!r} "
+                    f"(trace has {sorted(element_names)})")
+            if knob not in _WHAT_IF_ELEMENT_KNOBS:
+                raise click.ClickException(
+                    f"--what-if element knob {knob!r} is not one of "
+                    f"{_WHAT_IF_ELEMENT_KNOBS}")
+            overrides["elements"].setdefault(element, {})[
+                knob] = number
+        else:
+            knob = key.strip()
+            if knob not in _WHAT_IF_PIPELINE_KNOBS:
+                raise click.ClickException(
+                    f"--what-if knob {knob!r} is not one of "
+                    f"{_WHAT_IF_PIPELINE_KNOBS} (element knobs are "
+                    f"'element.knob=value')")
+            overrides[knob] = number
+    return overrides
+
+
+def _tune_what_if(trace, slo_spec, definition_path, run_name, what_if,
+                  static_costs=None) -> dict:
+    """Score explicit settings against the recorded cost model -- no
+    recommender in the loop, so CI can pin pure replay determinism."""
+    from .tune import (
+        CostModel, build_report, classify_elements,
+        element_settings_of, load_trace, predict)
+    loaded = load_trace(trace, definition=definition_path,
+                        run=run_name)
+    if static_costs is None:
+        static_costs = {}
+        if loaded.definition is not None:
+            from .analyze.shape_eval import element_cost_estimates
+            try:
+                static_costs = element_cost_estimates(
+                    loaded.definition)
+            except Exception:
+                static_costs = {}
+    model = CostModel.from_trace(
+        loaded, static_costs=static_costs,
+        dispatch_floor_s=slo_spec.dispatch_floor_s,
+        peak_flops=slo_spec.peak_flops)
+    classify_elements(model)
+    settings = element_settings_of(loaded.definition_document)
+    baseline = predict(model, settings)
+    overrides = _parse_what_if(what_if, set(loaded.elements))
+    proposed = predict(model, settings, overrides)
+    return build_report(loaded, model, slo_spec, [], baseline,
+                        proposed)
+
+
+def _tune_apply(loaded, report, apply_path) -> int:
+    """Write the tuned definition (from the ALREADY-loaded trace) and
+    re-lint it.  Returns the exit status (0 clean, 1 the applied
+    definition fails lint)."""
+    import json as json_module
+    from pathlib import Path
+
+    from .analyze import analyze_definition
+    from .tune import Recommendation, apply_recommendations
+
+    if loaded is None or loaded.definition_document is None:
+        click.echo("--apply needs a definition (embedded metadata or "
+                   "--definition)", err=True)
+        return 2
+    recommendations = [
+        Recommendation(**{key: record[key] for key in
+                          ("target", "knob", "current", "proposed",
+                           "reason", "floor", "evidence")})
+        for record in report.get("recommendations", [])]
+    document, diagnostics = apply_recommendations(
+        loaded.definition_document, recommendations)
+    for diagnostic in diagnostics:
+        click.echo(diagnostic.render(), err=True)
+    lint_report = analyze_definition(document,
+                                     passes=("graph", "policy"))
+    Path(apply_path).write_text(
+        json_module.dumps(document, indent=2) + "\n")
+    failures = lint_report.failures()
+    if failures:
+        click.echo(f"applied definition FAILS lint "
+                   f"({len(failures)} error(s)):", err=True)
+        for diagnostic in failures:
+            click.echo(f"  {diagnostic.render()}", err=True)
+        return 1
+    click.echo(f"applied {len(recommendations)} recommendation(s) -> "
+               f"{apply_path} (lint clean)")
+    return 0
+
+
+@main.command()
 def bench() -> None:
     """Run the standard benchmark (one JSON line)."""
     import runpy

@@ -105,13 +105,18 @@ def _gateway_plugin(model: "DashboardModel") -> list:
         lines.append("telemetry: (no summary yet -- disabled or first "
                      "interval pending; press m for live metrics)")
         return lines
-    lines.append(
+    admission_line = (
         f"admission: admitted {metrics.get('admitted', 0)}  "
         f"shed {metrics.get('shed_frames', 0)}  "
         f"routed {metrics.get('routed', 0)}  "
         f"completed {metrics.get('completed', 0)}  "
         f"parked {metrics.get('parked', 0)}  "
         f"failovers {metrics.get('failovers', 0)}")
+    if "admit_latency_p99_ms" in metrics:
+        admission_line += (
+            f"  latency p50 {metrics.get('admit_latency_p50_ms')}ms "
+            f"p99 {metrics.get('admit_latency_p99_ms')}ms")
+    lines.append(admission_line)
     pool_line = (
         f"pool: size {metrics.get('pool_size', 0)}  "
         f"pending {metrics.get('pending_spawns', 0)}  "
@@ -158,7 +163,11 @@ register_plugin("gateway", _gateway_plugin)
 
 def format_snapshot_lines(snapshot: dict, limit: int = 40) -> list:
     """Human-readable lines for one metrics snapshot: counters first
-    (sorted), then histograms as count/mean/max milliseconds."""
+    (sorted), then histograms as count/mean/p50/p99/max milliseconds.
+    Quantiles come from the shared snapshot_quantile helper (the one
+    implementation tune and the gateway summary also read), not an
+    ad-hoc re-derivation."""
+    from .observe.metrics import DEFAULT_BOUNDS, snapshot_quantile
     lines = []
     for name, value in sorted((snapshot.get("counters") or {}).items()):
         lines.append(f"{name:40} {value}")
@@ -169,8 +178,18 @@ def format_snapshot_lines(snapshot: dict, limit: int = 40) -> list:
         mean = (hist.get("sum", 0.0) / count) if count else 0.0
         high = hist.get("max", 0.0)
         # timing histograms (the "_s" naming convention) read in ms;
-        # occupancy/size histograms stay in their own unit
-        if "_s:" in name or name.endswith("_s"):
+        # occupancy/size histograms stay in their own unit (their
+        # custom bucket ladders are not in the snapshot, so quantiles
+        # are only printed for the standard timing ladder)
+        if ("_s:" in name or name.endswith("_s")) and (
+                len(hist.get("buckets") or []) == len(DEFAULT_BOUNDS) + 1):
+            p50 = snapshot_quantile(hist, 0.5)
+            p99 = snapshot_quantile(hist, 0.99)
+            lines.append(f"{name:40} n={count} mean={mean * 1000:.3f}ms "
+                         f"p50={p50 * 1000:.3f}ms "
+                         f"p99={p99 * 1000:.3f}ms "
+                         f"max={high * 1000:.3f}ms")
+        elif "_s:" in name or name.endswith("_s"):
             lines.append(f"{name:40} n={count} mean={mean * 1000:.3f}ms "
                          f"max={high * 1000:.3f}ms")
         else:

@@ -569,10 +569,19 @@ class LMGenerate(ComputeElement):
             if frame is not None:
                 telemetry.record_engine_frame(
                     frame, self.definition.name, stats)
+        # "time" is the element-compute share only: the engine's slot
+        # wait is reported as time_queue_{node} by record_engine_frame
+        # above, so time_{node} (written from this value by
+        # mark_resume) means the same thing on the engine-managed path
+        # as on the fused/chained ones -- tune's queue-vs-compute
+        # attribution depends on that
+        queue_wait = max((float(s.get("queue_wait_s", 0.0))
+                          for s in stats), default=0.0)
+        total = time.perf_counter() - entry["submitted_at"]
         pipeline.post_message("process_frame_response", [
             {"stream_id": stream_id, "frame_id": frame_id,
              "node": self.definition.name,
-             "time": time.perf_counter() - entry["submitted_at"]},
+             "time": max(total - queue_wait, 0.0)},
             outputs])
         del self._engine_frames[key]
 

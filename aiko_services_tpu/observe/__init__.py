@@ -7,8 +7,9 @@
 
 from .metrics import (                                      # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, get_registry,
-    merge_snapshots, snapshot_from_wire)
+    merge_snapshots, snapshot_from_wire, snapshot_quantile)
 from .trace import (                                        # noqa: F401
-    FrameTrace, Tracer, chrome_trace_document)
+    FrameTrace, Tracer, chrome_trace_document,
+    definition_fingerprint, trace_metadata, trace_metadata_of)
 from .telemetry import PipelineTelemetry                    # noqa: F401
 from .gateway import GatewayTelemetry                       # noqa: F401

@@ -107,7 +107,10 @@ class GatewayTelemetry:
         return self.registry.snapshot()
 
     def summary(self) -> dict:
-        """Compact scalars for the EC share / dashboards."""
+        """Compact scalars for the EC share / dashboards.  Admit-latency
+        quantiles come from the ONE shared Histogram.quantile helper
+        (the same estimate `aiko tune` and the dashboard read) instead
+        of an ad-hoc re-derivation."""
         summary = {
             "admitted": self.admitted.value,
             "shed_streams": self.shed_streams.value,
@@ -124,6 +127,11 @@ class GatewayTelemetry:
             "scale_ups": self.scale_ups.value,
             "scale_downs": self.scale_downs.value,
         }
+        if self.latency.count:
+            summary["admit_latency_p50_ms"] = round(
+                self.latency.quantile(0.5) * 1000, 3)
+            summary["admit_latency_p99_ms"] = round(
+                self.latency.quantile(0.99) * 1000, 3)
         if self.last_time_to_healthy_ms is not None:
             summary["time_to_healthy_ms"] = self.last_time_to_healthy_ms
         autoscaler = getattr(self.gateway, "autoscaler", None)

@@ -32,7 +32,7 @@ from ..utils import generate, parse_number
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "merge_snapshots", "parse_metrics_payload",
-    "snapshot_from_wire",
+    "snapshot_from_wire", "snapshot_quantile",
 ]
 
 # Geometric bucket ladder for timing histograms: 10 us doubling up to
@@ -98,11 +98,67 @@ class Histogram:
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile by linear interpolation inside the
+        log bucket holding the rank -- the ONE quantile-extraction
+        implementation (dashboard, gateway summary, and `aiko tune`
+        all read it; each used to re-derive quantiles ad hoc).
+        Empty -> 0.0; q<=0 -> observed min; q>=1 -> observed max;
+        interior bucket edges are clamped to the observed min/max so a
+        single-bucket histogram interpolates within real data, not the
+        full geometric bucket span."""
+        return snapshot_quantile(self.snapshot(), q, self.bounds)
+
     def snapshot(self) -> dict:
         return {"count": self.count, "sum": self.total,
                 "min": self.low if self.low is not None else 0.0,
                 "max": self.high if self.high is not None else 0.0,
                 "buckets": list(self.buckets)}
+
+
+def snapshot_quantile(snapshot: dict, q: float,
+                      bounds=None) -> float:
+    """Quantile extraction from a histogram SNAPSHOT dict (the shape
+    that rides the wire / the trace metadata): the same estimate as
+    Histogram.quantile, usable by consumers that only hold the
+    serialized form.  `bounds` defaults to DEFAULT_BOUNDS when the
+    bucket count matches it; snapshots of custom-ladder histograms
+    must pass their bounds explicitly."""
+    count = int(snapshot.get("count", 0) or 0)
+    if count <= 0:
+        return 0.0
+    low = float(snapshot.get("min", 0.0))
+    high = float(snapshot.get("max", 0.0))
+    q = float(q)
+    if q <= 0.0:
+        return low
+    if q >= 1.0:
+        return high
+    buckets = snapshot.get("buckets") or []
+    if bounds is None:
+        if len(buckets) == len(DEFAULT_BOUNDS) + 1:
+            bounds = DEFAULT_BOUNDS
+        else:
+            # unknown ladder: the only defensible estimate is the
+            # observed range itself
+            return low + (high - low) * q
+    rank = q * count
+    cumulative = 0
+    for index, bucket_count in enumerate(buckets):
+        if not bucket_count:
+            continue
+        if cumulative + bucket_count >= rank:
+            edge_low = bounds[index - 1] if index > 0 else 0.0
+            edge_high = (bounds[index] if index < len(bounds)
+                         else high)
+            # clamp to observed data: a single-bucket histogram must
+            # not report values outside [min, max]
+            edge_low = max(edge_low, low)
+            edge_high = max(min(edge_high, high), edge_low)
+            fraction = (rank - cumulative) / bucket_count
+            return edge_low + (edge_high - edge_low) * fraction
+        cumulative += bucket_count
+    return high
 
 
 def _merge_histogram(left: dict, right: dict) -> dict:

@@ -20,7 +20,7 @@ import time
 
 from ..utils import get_logger, truthy
 from .metrics import MetricsRegistry, get_registry
-from .trace import Tracer, now_us, to_us
+from .trace import Tracer, now_us, to_us, trace_metadata
 
 __all__ = ["PipelineTelemetry"]
 
@@ -215,6 +215,23 @@ class PipelineTelemetry:
         interleaved with other frames' slots."""
         if not self.enabled:
             return
+        # queue-wait vs compute split, SAME keys as the micro-batch
+        # paths: time_queue_{node} is scheduler/slot-induced wait (the
+        # frame completes when its slowest row does, so the frame's
+        # wait is the MAX row wait), and the response-side time_{node}
+        # (mark_resume) carries compute excluding that wait -- tune's
+        # attribution reads these keys identically on the fused,
+        # chained, and engine-managed paths
+        queue_wait_s = max(
+            (float(stats.get("queue_wait_s", 0.0))
+             for stats in stats_rows), default=0.0)
+        key = "time_queue_" + node
+        frame.metrics[key] = frame.metrics.get(key, 0.0) + queue_wait_s
+        histogram = self._queue_hists.get(node)
+        if histogram is None:
+            histogram = self._queue_hists[node] = (
+                self.registry.histogram("queue_s:" + node))
+        histogram.record(queue_wait_s)
         trace = frame.trace
         if trace is None:
             return
@@ -476,6 +493,28 @@ class PipelineTelemetry:
         return self.tracer.chrome_events(
             process_name=f"pipeline:{self.pipeline.name}")
 
-    def export_trace(self, path: str) -> int:
+    def trace_metadata(self, config: dict | None = None,
+                       config_name: str | None = None) -> dict:
+        """Self-describing metadata for this pipeline's trace export:
+        the definition document (reconstructed from the live
+        definition, so applied parameter updates are captured), its
+        fingerprint, and a metrics snapshot -- everything `aiko tune`
+        needs to replay the trace with no side-channel files."""
+        from ..pipeline.definition import definition_to_document
+        metadata = trace_metadata(
+            definition_document=definition_to_document(
+                self.pipeline.definition),
+            config=config, config_name=config_name,
+            metrics=self.snapshot())
+        # this tracer's synthetic pid: when several pipelines' events
+        # share one artifact (bench combined file, router replicas),
+        # the tune loader filters spans to the selected run's pids
+        metadata["pids"] = [self.tracer._pid]
+        return metadata
+
+    def export_trace(self, path: str, config: dict | None = None,
+                     config_name: str | None = None) -> int:
         return self.tracer.export(
-            path, process_name=f"pipeline:{self.pipeline.name}")
+            path, process_name=f"pipeline:{self.pipeline.name}",
+            metadata=self.trace_metadata(config=config,
+                                         config_name=config_name))

@@ -17,13 +17,21 @@
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import os
 import time
 from collections import deque
 
-__all__ = ["FrameTrace", "Tracer", "chrome_trace_document"]
+__all__ = ["FrameTrace", "Tracer", "chrome_trace_document",
+           "definition_fingerprint", "trace_metadata",
+           "trace_metadata_of"]
+
+# trace-metadata schema version: bumped when the embedded layout
+# changes; the tune/ loader refuses versions it does not understand
+# instead of silently mis-reading spans
+TRACE_METADATA_SCHEMA = 1
 
 # One clock epoch per process: every span timestamp is microseconds since
 # this moment, so spans from different streams/elements line up on one
@@ -186,14 +194,72 @@ class Tracer:
             event["s"] = "t"  # instant scope: thread
         return event
 
-    def export(self, path: str, process_name: str = "pipeline") -> int:
-        """Write a Perfetto-loadable trace file; returns event count."""
+    def export(self, path: str, process_name: str = "pipeline",
+               metadata: dict | None = None) -> int:
+        """Write a Perfetto-loadable trace file; returns event count.
+        `metadata` (see trace_metadata) makes the artifact
+        self-describing for `aiko tune`."""
         document = chrome_trace_document(
-            self.chrome_events(process_name=process_name))
+            self.chrome_events(process_name=process_name),
+            metadata=metadata)
         with open(path, "w") as handle:
             json.dump(document, handle)
         return len(document["traceEvents"])
 
 
-def chrome_trace_document(events: list) -> dict:
-    return {"traceEvents": list(events), "displayTimeUnit": "ms"}
+def chrome_trace_document(events: list,
+                          metadata: dict | None = None) -> dict:
+    """Chrome-trace JSON document.  The optional `metadata` dict rides
+    the spec's top-level "metadata" key under an "aiko" namespace --
+    Perfetto/chrome://tracing ignore it, `aiko tune` requires it: a
+    trace artifact that embeds its own pipeline definition + parameter
+    fingerprint + bench config block is replayable with no side-channel
+    files."""
+    document = {"traceEvents": list(events), "displayTimeUnit": "ms"}
+    if metadata is not None:
+        document["metadata"] = {"aiko": metadata}
+    return document
+
+
+def definition_fingerprint(document: dict) -> str:
+    """Stable content hash of a definition document (canonical JSON):
+    the parameter fingerprint a trace is stamped with, so tune can
+    tell whether a recommendation was computed against the SAME
+    definition+parameters it is about to be applied to."""
+    canonical = json.dumps(document, sort_keys=True, default=str)
+    return "sha256:" + hashlib.sha256(
+        canonical.encode("utf-8")).hexdigest()
+
+
+def trace_metadata(definition_document: dict | None = None,
+                   config: dict | None = None,
+                   config_name: str | None = None,
+                   metrics: dict | None = None) -> dict:
+    """Assemble the self-describing metadata block one trace artifact
+    carries: the pipeline definition it was recorded under (with its
+    fingerprint), the bench config block that produced it, and a
+    metrics-registry snapshot taken at export."""
+    metadata: dict = {"schema": TRACE_METADATA_SCHEMA}
+    if definition_document is not None:
+        metadata["definition"] = definition_document
+        metadata["fingerprint"] = definition_fingerprint(
+            definition_document)
+    if config is not None:
+        metadata["config"] = config
+    if config_name is not None:
+        metadata["config_name"] = config_name
+    if metrics is not None:
+        metadata["metrics"] = metrics
+    return metadata
+
+
+def trace_metadata_of(document: dict) -> dict | None:
+    """The aiko metadata block of a loaded trace document, or None for
+    pre-metadata traces (any Chrome-trace JSON from another tool)."""
+    if not isinstance(document, dict):
+        return None
+    metadata = document.get("metadata")
+    if not isinstance(metadata, dict):
+        return None
+    aiko = metadata.get("aiko")
+    return aiko if isinstance(aiko, dict) else None

@@ -24,7 +24,8 @@ from ..utils import Graph
 
 __all__ = [
     "PipelineDefinition", "ElementDefinition", "DefinitionError",
-    "parse_pipeline_definition", "validate_pipeline_definition",
+    "definition_to_document", "parse_pipeline_definition",
+    "validate_pipeline_definition",
 ]
 
 
@@ -69,6 +70,47 @@ class PipelineDefinition:
             if definition.name == name:
                 return definition
         return None
+
+
+def definition_to_document(definition: PipelineDefinition) -> dict:
+    """The inverse of parse_pipeline_definition: a JSON-able document
+    that re-parses to an equivalent definition.  Used by the trace
+    exporter (a Perfetto artifact embeds the definition it was recorded
+    under, so `aiko tune` can replay it without side-channel files) and
+    by `aiko tune --apply` (recommendations are written back as a
+    definition document and re-linted)."""
+    elements = []
+    for element in definition.elements:
+        record: dict = {"name": element.name}
+        if element.input:
+            record["input"] = [dict(port) for port in element.input]
+        if element.output:
+            record["output"] = [dict(port) for port in element.output]
+        if element.parameters:
+            record["parameters"] = dict(element.parameters)
+        if element.map_in:
+            record["map_in"] = dict(element.map_in)
+        if element.map_out:
+            record["map_out"] = dict(element.map_out)
+        if element.sharding:
+            record["sharding"] = dict(element.sharding)
+        record["deploy"] = (
+            {"local": dict(element.deploy_local)}
+            if element.deploy_local is not None
+            else {"remote": dict(element.deploy_remote or {})})
+        elements.append(record)
+    document = {
+        "name": definition.name,
+        "graph": list(definition.graph),
+        "elements": elements,
+    }
+    if definition.version:
+        document["version"] = definition.version
+    if definition.runtime != "jax":
+        document["runtime"] = definition.runtime
+    if definition.parameters:
+        document["parameters"] = dict(definition.parameters)
+    return document
 
 
 def _require(condition, message):

@@ -45,11 +45,17 @@ ON_DEVICE = os.environ.get("AIKO_BENCH_ON_DEVICE", "1") != "0"
 # published in every config block so A/B JSON is self-describing
 TELEMETRY = os.environ.get("AIKO_BENCH_TELEMETRY", "1") != "0"
 # --trace <path>: accumulate Chrome-trace events from every benched
-# pipeline (the config-5 graph included) and ship the Perfetto-loadable
-# file alongside the JSON
+# pipeline (the config-5 graph included).  EVERY pipeline-running
+# config writes its OWN self-describing artifact named by config
+# (<path minus .json>.<config>.json -- definition + parameter
+# fingerprint + config block + metrics snapshot embedded in the trace
+# metadata, so `aiko tune` replays it with no side-channel files), the
+# artifact path is published in that config's block, and the combined
+# legacy file at <path> still carries every span
 _TRACE_PATH = None
 _TRACE_EVENTS: list = []
 _TRACE_DROPPED = 0
+_TRACE_RUNS: dict = {}  # config label -> {events, metadata, dropped}
 # --faults <seed>: the serving config runs under a seeded 1%-frame
 # transient fault rate at the detector (on_error: retry recovers every
 # poisoned frame), publishing injected/retry/dead-letter counts in its
@@ -154,6 +160,85 @@ def _honest_elapsed(start, refs):
     return max(time.perf_counter() - start, 1e-9)
 
 
+def _harvest_trace(pipeline, config_label: str | None = None) -> None:
+    """Collect one benched pipeline's frame traces before teardown:
+    into the combined file's event list AND into the per-config run
+    (self-describing metadata captured here, while the live pipeline
+    can still report its definition + metrics snapshot)."""
+    if not _TRACE_PATH:
+        return
+    global _TRACE_DROPPED
+    label = config_label or pipeline.definition.name
+    if label.startswith("bench_"):
+        label = label[len("bench_"):]
+    events = pipeline.telemetry.chrome_events()
+    _TRACE_EVENTS.extend(events)
+    _TRACE_DROPPED += pipeline.telemetry.tracer.dropped
+    run = _TRACE_RUNS.setdefault(label, {"events": [], "dropped": 0})
+    run["events"].extend(events)
+    run["dropped"] += pipeline.telemetry.tracer.dropped
+    metadata = pipeline.telemetry.trace_metadata(config_name=label)
+    previous = run.get("metadata")
+    if previous is not None:
+        # several pipelines harvested under ONE config (router
+        # replicas, serving arms): the metrics snapshot must cover
+        # them ALL, not just the last -- counters from a
+        # single-replica snapshot would understate an N-replica trace
+        # -- and the pid list must name every tracer so the tune
+        # loader keeps all of this config's spans (and ONLY them)
+        from aiko_services_tpu.observe import merge_snapshots
+        metadata["metrics"] = merge_snapshots(
+            previous.get("metrics") or {}, metadata.get("metrics")
+            or {})
+        metadata["pids"] = sorted(
+            set(previous.get("pids") or [])
+            | set(metadata.get("pids") or []))
+    run["metadata"] = metadata
+
+
+def _write_config_traces(configs: dict, result: dict) -> dict:
+    """One artifact per harvested config, named by config, path
+    published in the config block.  Returns the combined-file metadata
+    (every run's metadata under a "runs" map)."""
+    from aiko_services_tpu.observe import chrome_trace_document
+    from aiko_services_tpu.observe.trace import TRACE_METADATA_SCHEMA
+    base, ext = os.path.splitext(_TRACE_PATH)
+    # harvest label (definition name minus "bench_") -> config key
+    config_key_of = {"multimodal": "pipeline_multimodal",
+                     "det": "detector"}
+    trace_files = {}
+    runs_metadata = {}
+    for label in sorted(_TRACE_RUNS):
+        run = _TRACE_RUNS[label]
+        key = config_key_of.get(label, label)
+        block = configs.get(key)
+        metadata = dict(run.get("metadata") or {})
+        if block is not None:
+            # the config block is embedded BEFORE trace_file is added
+            # to it (no self-reference); tune reads capacity/MFU/peak
+            # evidence from it
+            metadata["config"] = dict(block)
+            metadata["config_name"] = key
+        metadata["dropped_frames"] = run["dropped"]
+        runs_metadata[label] = metadata
+        path = f"{base}.{label}{ext or '.json'}"
+        try:
+            with open(path, "w") as handle:
+                json.dump(chrome_trace_document(run["events"],
+                                                metadata=metadata),
+                          handle)
+        except OSError as error:
+            result["trace_error"] = str(error)
+            continue
+        trace_files[key] = path
+        if block is not None:
+            block["trace_file"] = path
+            block["trace_events"] = len(run["events"])
+    if trace_files:
+        result["trace_files"] = trace_files
+    return {"schema": TRACE_METADATA_SCHEMA, "runs": runs_metadata}
+
+
 def _run_pipeline(definition, warmup: int, measure: int,
                   ready_key: str, timeout: float = 900,
                   latency_frames: int | None = None,
@@ -225,13 +310,10 @@ def _run_pipeline(definition, warmup: int, measure: int,
     drain_start = time.perf_counter()
     drain = _honest_elapsed(drain_start, lat_refs)  # device backlog
     pipeline.destroy_stream("latency")
-    if _TRACE_PATH:
-        # harvest this pipeline's frame traces before teardown; every
-        # benched graph lands in ONE Perfetto file (distinct process
-        # names per config)
-        global _TRACE_DROPPED
-        _TRACE_EVENTS.extend(pipeline.telemetry.chrome_events())
-        _TRACE_DROPPED += pipeline.telemetry.tracer.dropped
+    # harvest this pipeline's frame traces before teardown; every
+    # benched graph lands in its own per-config artifact AND the
+    # combined Perfetto file (distinct process names per config)
+    _harvest_trace(pipeline)
     process.terminate()
     # a stage that drops "t0" would silently degrade p50 into a
     # throughput-derived estimate -- fail loudly instead
@@ -1021,10 +1103,7 @@ def bench_serving(peak):
             _, _, outputs = responses.get(timeout=900)
             refs.append(outputs.get("detections"))
         elapsed = _honest_elapsed(start, refs)
-        if _TRACE_PATH:
-            global _TRACE_DROPPED
-            _TRACE_EVENTS.extend(pipeline.telemetry.chrome_events())
-            _TRACE_DROPPED += pipeline.telemetry.tracer.dropped
+        _harvest_trace(pipeline)
         if _FAULTS_SEED is not None:
             stats = (pipeline.faults.stats()
                      if pipeline.faults is not None else {})
@@ -1239,6 +1318,8 @@ def bench_router(peak, replicas_n: int):
     goodput = counts["ok"] / elapsed
     shed_rate = counts["shed"] / max(offered, 1)
     summary = gateway.telemetry.summary()
+    for replica in replicas:  # every replica's spans, one router run
+        _harvest_trace(replica, config_label="router")
     for proc in processes:
         proc.terminate()
     flops = detector_flops_per_image(config)
@@ -2264,7 +2345,7 @@ def compact_headline(detail: dict, cap: int = HEADLINE_LINE_CAP) -> str:
     compact["detail_file"] = "BENCH_DETAIL.json"
     # progressive field drops keep the guarantee even if units/summary
     # grow; never drop metric/value/vs_baseline
-    for drop in (None, "trace_file", "trace_events",
+    for drop in (None, "trace_file", "trace_files", "trace_events",
                  "trace_frames_dropped", "summary",
                  "baseline", "unit", "peak_tflops_assumed",
                  "device_fallback"):
@@ -2426,12 +2507,16 @@ def main() -> None:
     if _FAULTS_SEED is not None:
         result["faults_seed"] = _FAULTS_SEED  # self-describing A/B arm
     if _TRACE_PATH:
-        # the trace artifact ships alongside the JSON: every benched
-        # pipeline's frame spans in one Perfetto-loadable file
+        # trace artifacts ship alongside the JSON: one self-describing
+        # per-config file each (path published in the config block,
+        # `aiko tune` input) plus the combined legacy file with every
+        # benched pipeline's spans
         from aiko_services_tpu.observe import chrome_trace_document
+        combined_metadata = _write_config_traces(configs, result)
         try:
             with open(_TRACE_PATH, "w") as handle:
-                json.dump(chrome_trace_document(_TRACE_EVENTS), handle)
+                json.dump(chrome_trace_document(
+                    _TRACE_EVENTS, metadata=combined_metadata), handle)
             result["trace_file"] = _TRACE_PATH
             result["trace_events"] = len(_TRACE_EVENTS)
             # truncation is explicit: frames evicted from the bounded

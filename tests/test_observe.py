@@ -12,7 +12,8 @@ import numpy as np
 import pytest
 
 from aiko_services_tpu.observe import (
-    MetricsRegistry, merge_snapshots, snapshot_from_wire)
+    Histogram, MetricsRegistry, merge_snapshots, snapshot_from_wire,
+    snapshot_quantile)
 from aiko_services_tpu.dashboard import format_snapshot_lines
 from aiko_services_tpu.pipeline import (
     AsyncHostElement, ComputeElement, PipelineElement, StreamEvent,
@@ -119,6 +120,64 @@ class TestMetrics:
         empty.histogram("h")
         merged = merge_snapshots(empty.snapshot(), left)
         assert merged["histograms"]["h"]["min"] == 2.0 ** -16
+
+    def test_histogram_quantile_log_bucket_edges(self):
+        """The ONE quantile-extraction helper (dashboard, gateway
+        summary, and tune all read it): empty, single-bucket, q=0/1,
+        and interior interpolation."""
+        empty = Histogram()
+        assert empty.quantile(0.5) == 0.0
+        assert empty.quantile(0.0) == 0.0 and empty.quantile(1.0) == 0.0
+        # single bucket: every sample lands in one log bucket -- the
+        # estimate must interpolate within [min, max], never report
+        # the bucket's full geometric span
+        single = Histogram()
+        for value in (0.0010, 0.0011, 0.0012):
+            single.record(value)
+        assert single.quantile(0.0) == 0.0010
+        assert single.quantile(1.0) == 0.0012
+        assert 0.0010 <= single.quantile(0.5) <= 0.0012
+        # q clamps outside [0, 1]
+        assert single.quantile(-3) == 0.0010
+        assert single.quantile(7) == 0.0012
+        # interior: 90 fast + 10 slow samples -- p50 stays in the fast
+        # bucket's range, p99 in the slow one's
+        mixed = Histogram()
+        for _ in range(90):
+            mixed.record(0.001)
+        for _ in range(10):
+            mixed.record(1.0)
+        assert mixed.quantile(0.5) < 0.01
+        assert mixed.quantile(0.99) > 0.5
+        assert mixed.quantile(0.999) <= mixed.quantile(1.0) == 1.0
+
+    def test_snapshot_quantile_matches_and_handles_unknown_ladder(self):
+        histogram = Histogram()
+        for value in (0.0001, 0.004, 0.02, 2.5):
+            histogram.record(value)
+        snapshot = histogram.snapshot()
+        for q in (0.0, 0.25, 0.5, 0.9, 1.0):
+            assert snapshot_quantile(snapshot, q) == \
+                histogram.quantile(q)
+        # custom-ladder snapshot without bounds: falls back to the
+        # observed range instead of mis-reading the buckets
+        custom = Histogram(bounds=(1, 2, 4))
+        for value in (1.5, 3.0):
+            custom.record(value)
+        estimate = snapshot_quantile(custom.snapshot(), 0.5)
+        assert 1.5 <= estimate <= 3.0
+        # with explicit bounds the ladder is used
+        assert snapshot_quantile(custom.snapshot(), 0.5,
+                                 bounds=(1, 2, 4)) == \
+            custom.quantile(0.5)
+
+    def test_dashboard_lines_show_shared_quantiles(self):
+        registry = MetricsRegistry()
+        for _ in range(50):
+            registry.histogram("element_s:asr").record(0.002)
+        lines = format_snapshot_lines(registry.snapshot())
+        line = next(line for line in lines if "element_s:asr" in line)
+        assert "p50=" in line and "p99=" in line
 
     def test_sexpr_wire_round_trip(self):
         registry = MetricsRegistry()
@@ -241,6 +300,121 @@ class TestTracing:
         assert all(value == 0 for value in snapshot["counters"].values())
         assert snapshot["histograms"] == {}
         process.terminate()
+
+
+# -- queue-wait vs compute split: one contract, three dispatch paths ---------
+
+class TestQueueComputeSplit:
+    """`time_queue_{node}` (scheduler/slot-induced wait) vs
+    `time_{node}` (element compute) must mean the SAME thing on the
+    fused, chained, and engine-managed (decode/) paths -- tune/'s
+    attribution depends on it (ISSUE 10 satellite)."""
+
+    def _run(self, definition, frames, make_frame):
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(process, definition)
+        responses = queue.Queue()
+        stream = pipeline.create_stream("s", queue_response=responses,
+                                        grace_time=300)
+        for index in range(frames):
+            pipeline.create_frame(stream, make_frame(index))
+        process.run(in_thread=True)
+        results = [responses.get(timeout=120) for _ in range(frames)]
+        traces = list(pipeline.telemetry.tracer.completed)
+        process.terminate()
+        return results, traces
+
+    def _assert_split(self, results, traces, node, path):
+        for _, frame, _ in results:
+            assert f"time_{node}" in frame.metrics, frame.metrics
+            assert f"time_queue_{node}" in frame.metrics, frame.metrics
+            assert frame.metrics[f"time_{node}"] >= 0.0
+            assert frame.metrics[f"time_queue_{node}"] >= 0.0
+        for trace in traces:
+            names = {name for _, name, *_ in trace.events}
+            assert f"queue:{node}" in names \
+                or any(name.startswith(f"queue:{node}[")
+                       for name in names)
+            spans = [event for event in trace.events
+                     if event[0] == "X" and event[1] == node]
+            if path is not None:
+                assert spans and spans[0][5]["path"] == path
+
+    def test_fused_path_split(self):
+        results, traces = self._run(
+            _observed_definition(micro_batch=4), 4,
+            lambda index: {"x": np.full((2, 3), float(index),
+                                        np.float32)})
+        self._assert_split(results, traces, "fused", "fused")
+
+    def test_chained_path_split(self):
+        # PlainDouble has no group_kernel: micro_batch > 1 coalesces
+        # on the CHAINED path -- same keys, same meaning
+        definition = {
+            "name": "chained_split",
+            "parameters": {"metrics_interval": 0},
+            "graph": ["(plain)"],
+            "elements": [
+                {"name": "plain", "input": [{"name": "x"}],
+                 "output": [{"name": "y"}],
+                 "parameters": {"micro_batch": 4},
+                 "deploy": _local("PlainDouble")},
+            ],
+        }
+        results, traces = self._run(
+            definition, 4,
+            lambda index: {"x": np.full((2, 3), float(index),
+                                        np.float32)})
+        self._assert_split(results, traces, "plain", "chained")
+
+    def test_engine_managed_path_split(self):
+        # LMGenerate `continuous: true`: the engine's slot wait lands
+        # in time_queue_lm and the response-side time_lm is compute
+        # EXCLUDING that wait (the engine subtracts it), matching the
+        # micro-batch paths where the queue interval closes before
+        # element_start
+        definition = {
+            "name": "engine_split",
+            "parameters": {"metrics_interval": 0},
+            "graph": ["(lm)"],
+            "elements": [
+                {"name": "lm", "input": [{"name": "tokens"}],
+                 "output": [{"name": "generated"}],
+                 "parameters": {
+                     "vocab_size": 300, "d_model": 32, "n_layers": 1,
+                     "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+                     "max_seq_len": 128, "dtype": "float32",
+                     "max_new_tokens": 4, "continuous": True,
+                     "decode_slots": 2, "kv_block_size": 8},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements",
+                     "class_name": "LMGenerate"}}},
+            ],
+        }
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 300, size=(1, 7)).astype(np.int32)
+                   for _ in range(3)]
+        results, traces = self._run(definition, 3,
+                                    lambda index: {"tokens":
+                                                   prompts[index]})
+        self._assert_split(results, traces, "lm", None)
+        # the engine path ALSO reconstructs per-slot prefill/decode
+        # spans onto the frame trace
+        for trace in traces:
+            names = {name for _, name, *_ in trace.events}
+            assert any(name.startswith("prefill:lm")
+                       for name in names)
+            assert any(name.startswith("decode_steps:lm")
+                       for name in names)
+        # compute excludes the slot wait: the split halves sum to at
+        # most the frame's own wall time
+        trace_by_frame = {trace.frame_id: trace for trace in traces}
+        for _, frame, _ in results:
+            trace = trace_by_frame[frame.frame_id]
+            wall_s = (trace.end_us - trace.start_us) / 1e6
+            assert (frame.metrics["time_lm"]
+                    + frame.metrics["time_queue_lm"]) \
+                <= wall_s + 0.05
 
 
 # -- export over the control plane -------------------------------------------
