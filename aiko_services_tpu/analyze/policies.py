@@ -11,13 +11,14 @@
 from __future__ import annotations
 
 from .diagnostics import AnalysisReport, Diagnostic
-from .grammar import Field
+from .grammar import Field, GrammarError, split_directives
 
 __all__ = ["run_policy_pass", "check_gateway_policy",
            "check_autoscale_policy", "check_faults_spec",
            "check_journal_policy", "check_decode_parameters",
-           "check_tune_spec", "FAULT_TOLERANCE_FIELDS",
-           "DECODE_FIELDS"]
+           "check_tune_spec", "parse_speculative_spec",
+           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS",
+           "SPECULATIVE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -43,7 +44,52 @@ DECODE_FIELDS = {
     "kv_blocks": Field("int", minimum=2),
     "max_context": Field("int", minimum=1),
     "eos_id": Field("int", minimum=0),
+    "prefill_chunk_size": Field("int", minimum=1),
+    "speculative": Field("str"),
 }
+
+# The `speculative` directive (LMGenerate parameter, `;`-separated
+# key=value through the shared grammar core): greedy-exact speculative
+# decoding on the continuous engine.  `draft` selects the proposal
+# model -- an _LM_PRESETS name, or "self" for the target's own config
+# family shrunk by the `layers`/`d_ff` overrides (random-init from
+# `seed`; the production path loads a trained draft via a preset).
+# `k` is the proposal run length per verify window.
+SPECULATIVE_FIELDS = {
+    "draft": Field("str"),
+    "k": Field("int", minimum=1, maximum=16),
+    "layers": Field("int", minimum=1),
+    "d_ff": Field("int", minimum=1),
+    "seed": Field("int", minimum=0),
+}
+
+
+def parse_speculative_spec(spec) -> dict:
+    """`draft=<preset|self>;k=<n>[;layers=<n>][;d_ff=<n>][;seed=<n>]`
+    -> coerced dict.  Raises GrammarError (a ValueError) with the same
+    message offline lint reports as AIKO405."""
+    parsed = {}
+    for part in split_directives(spec):
+        key, separator, value = part.partition("=")
+        key = key.strip()
+        if not separator or key not in SPECULATIVE_FIELDS:
+            raise GrammarError(
+                f"speculative: unknown entry {part!r}; expected "
+                f"key=value with keys {sorted(SPECULATIVE_FIELDS)}",
+                kind="unknown")
+        parsed[key] = SPECULATIVE_FIELDS[key].coerce(
+            "speculative", key, value.strip())
+    for required in ("draft", "k"):
+        if required not in parsed:
+            raise GrammarError(
+                f"speculative: missing required entry "
+                f"{required}=<value>")
+    if parsed["draft"] != "self" and (
+            "layers" in parsed or "d_ff" in parsed):
+        raise GrammarError(
+            "speculative: layers=/d_ff= overrides only apply to "
+            "draft=self (a preset draft has its own dims)")
+    return parsed
 
 
 def check_decode_parameters(parameters: dict) -> list:
@@ -61,6 +107,20 @@ def check_decode_parameters(parameters: dict) -> list:
             clean[key] = field.coerce("decode", key, parameters[key])
         except ValueError as error:
             problems.append(("AIKO405", str(error)))
+    if "speculative" in clean:
+        try:
+            parse_speculative_spec(clean["speculative"])
+        except ValueError as error:
+            problems.append(("AIKO405", str(error)))
+    # both kernel-floor features ride the continuous engine: on the
+    # closed-batch path they would be silently ignored, which is a
+    # misconfiguration worth failing offline
+    for feature in ("speculative", "prefill_chunk_size"):
+        if feature in clean and not clean.get("continuous"):
+            problems.append((
+                "AIKO405",
+                f"{feature} requires continuous=true (the closed-batch "
+                f"path ignores it)"))
     if problems or not clean.get("continuous"):
         return problems
     block_size = clean.get("kv_block_size", 16)

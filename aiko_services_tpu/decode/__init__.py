@@ -3,11 +3,15 @@
 #
 #   blocks.py   BlockManager -- fixed-size KV block pool bookkeeping
 #   engine.py   DecodeEngine -- slot scheduler: mid-decode admission /
-#               eviction / preemption with zero recompiles
+#               eviction / preemption with zero recompiles, chunked
+#               prefill (prefill_chunk_size) interleaved with decode,
+#               and greedy-exact speculative decoding (draft model +
+#               spec_k verify windows)
 #
 # Device kernels live in models/transformer.py (init_paged_pool,
-# paged_prefill, paged_decode_step) next to the closed-batch generate()
-# they must stay bit-compatible with.
+# paged_prefill, paged_prefill_chunk, paged_decode_step,
+# paged_verify_step) next to the closed-batch generate() they must
+# stay bit-compatible with.
 
 from .blocks import BlockManager, TRASH_BLOCK      # noqa: F401
 from .engine import Completion, DecodeEngine, StepReport  # noqa: F401

@@ -23,10 +23,30 @@
 #     re-prefill) so the oldest slot always progresses -- no livelock;
 #     greedy decode is deterministic, so a preempted request's
 #     regenerated tokens are identical and `emitted_upto` dedupes its
-#     token stream.
+#     token stream.  A slot preempted MID-CHUNKED-PREFILL discards its
+#     partially written blocks back to the free list the same way.
+#
+# Two kernel-floor lifts ride the same slot machinery (ROADMAP #3):
+#   - CHUNKED PREFILL (prefill_chunk_size): instead of one monolithic
+#     per-bucket prefill call that convoys every co-scheduled decode
+#     slot for the whole prompt, a prefilling slot consumes its prompt
+#     `prefill_chunk_size` tokens per engine tick (paged_prefill_chunk
+#     attends to the already-written KV blocks of earlier chunks), so
+#     decode steps interleave with prefill progress
+#     (decode.chunk_interleaves counts ticks where both ran);
+#   - GREEDY-EXACT SPECULATIVE DECODING (draft_params/draft_config/
+#     spec_k): a small draft proposes k tokens per slot, the target
+#     verifies all k+1 window positions in ONE batched forward
+#     (paged_verify_step) and accepts the longest greedy-matching
+#     prefix -- the weight stream that floors small-batch decode is
+#     read once per k+1 positions instead of once per token, while
+#     emitted tokens stay bit-identical to plain greedy decode.  The
+#     draft keeps its own fully-reserved paged pool with static
+#     per-slot block rows, so speculation never touches the target
+#     pool's allocation/preemption logic.
 #
 # Everything here runs on the event loop (host bookkeeping is a few
-# numpy writes per step); the device work is the one fused step call.
+# numpy writes per step); the device work is the fused step calls.
 
 from __future__ import annotations
 
@@ -37,7 +57,9 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..models import init_paged_pool, paged_decode_step, paged_prefill
+from ..models import (
+    init_paged_pool, paged_decode_step, paged_prefill,
+    paged_prefill_chunk, paged_verify_step)
 from ..utils import get_logger
 from ..utils.padding import bucket_length
 from .blocks import TRASH_BLOCK, BlockManager
@@ -80,19 +102,30 @@ class StepReport:
 
 
 class _Slot:
-    __slots__ = ("request", "blocks", "seq", "true_len")
+    __slots__ = ("request", "blocks", "seq", "true_len", "bucket",
+                 "padded", "prefill_pos", "draft_pending")
 
     def __init__(self, request: _Request, blocks: list, seq: int,
-                 true_len: int):
+                 true_len: int, bucket: int, padded: np.ndarray):
         self.request = request
         self.blocks = blocks
         self.seq = seq            # admission order; preemption victims
         self.true_len = true_len  # are chosen youngest (max seq) first
+        self.bucket = bucket
+        self.padded = padded      # (bucket,) right-padded prompt
+        self.prefill_pos = 0      # prompt tokens already written
+        self.draft_pending = []   # emitted tokens the draft hasn't seen
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prefill_pos < self.true_len
 
 
 def _jit_cache_size() -> int:
     return (paged_prefill._cache_size()
-            + paged_decode_step._cache_size())
+            + paged_decode_step._cache_size()
+            + paged_prefill_chunk._cache_size()
+            + paged_verify_step._cache_size())
 
 
 class DecodeEngine:
@@ -108,6 +141,8 @@ class DecodeEngine:
     def __init__(self, params, config, *, decode_slots: int = 4,
                  kv_block_size: int = 16, kv_blocks: int | None = None,
                  max_context: int | None = None, eos_id: int | None = None,
+                 prefill_chunk_size: int | None = None,
+                 draft_params=None, draft_config=None, spec_k: int = 0,
                  registry=None):
         if decode_slots < 1:
             raise ValueError(f"decode_slots must be >= 1, "
@@ -134,9 +169,54 @@ class DecodeEngine:
         self.waiting: deque[_Request] = deque()
         self._admission_seq = 0
         self._registry = registry
+        # chunked prefill: coerced to a power-of-two block multiple so
+        # the per-chunk executables stay logarithmic; a chunk covering
+        # max_context degenerates to the monolithic path
+        if prefill_chunk_size is not None:
+            chunk = bucket_length(int(prefill_chunk_size),
+                                  minimum=self.blocks.block_size)
+            self.prefill_chunk = int(min(chunk, self.max_context))
+        else:
+            self.prefill_chunk = None
+        # greedy-exact speculative decoding: draft model + window size
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError("speculative decoding needs BOTH "
+                             "draft_params and draft_config")
+        self.spec_k = int(spec_k or 0)
+        if self.spec_k and draft_params is None:
+            raise ValueError(f"spec_k={self.spec_k} needs a draft model "
+                             f"(draft_params/draft_config)")
+        if draft_params is not None and self.spec_k < 1:
+            self.spec_k = 4
+        self.draft_params = draft_params
+        self.draft_config = draft_config
+        if draft_config is not None:
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {draft_config.vocab_size} != "
+                    f"target vocab_size {config.vocab_size}: proposals "
+                    f"would index a different token space")
+            # the draft pool is FULLY reserved with a static block row
+            # per slot: the draft is small, so the reservation is cheap
+            # and speculation stays out of the target pool's
+            # allocation/preemption logic entirely
+            self.draft_pool = init_paged_pool(
+                draft_config, self.slots_n * self.max_blocks + 1,
+                self.blocks.block_size)
+            self.draft_tables = np.zeros(
+                (self.slots_n, self.max_blocks), np.int32)
+            for index in range(self.slots_n):
+                self.draft_tables[index] = (
+                    1 + index * self.max_blocks
+                    + np.arange(self.max_blocks))
+            self.draft_positions = np.zeros((self.slots_n,), np.int32)
+        self.spec_draft_s = 0.0
+        self.spec_verify_s = 0.0
         self.counters = {"admitted": 0, "completed": 0, "preempted": 0,
                          "deferred_admissions": 0, "cancelled": 0,
-                         "compiles": 0}
+                         "compiles": 0, "prefill_chunks": 0,
+                         "chunk_interleaves": 0, "spec_windows": 0,
+                         "spec_drafted": 0, "spec_accepted": 0}
         self._update_gauges()
 
     # -- submission --------------------------------------------------------
@@ -203,11 +283,15 @@ class DecodeEngine:
     # -- the engine step ---------------------------------------------------
 
     def step(self) -> StepReport:
-        """One engine tick: admit waiting requests into free slots at
-        the prefill boundary, grow/preempt block allocations, then run
-        ONE fused decode step over all slots."""
+        """One engine tick: admit waiting requests into free slots,
+        advance every mid-prefill slot by one chunk, grow/preempt block
+        allocations, then run ONE fused decode (or speculative verify)
+        step over the decoding slots.  Chunked prefill progress and
+        decode progress share the tick -- that interleaving is what
+        stops a long prompt from convoying every co-scheduled slot."""
         report = StepReport()
         self._admit(report)
+        ran_chunk = self._advance_prefills(report)
         active = [index for index, slot in enumerate(self.slots)
                   if slot is not None]
         if not active:
@@ -218,12 +302,29 @@ class DecodeEngine:
         active = [index for index, slot in enumerate(self.slots)
                   if slot is not None]
         report.active = len(active)
-        if not active:
+        decoding = [index for index in active
+                    if not self.slots[index].prefilling]
+        if not decoding:
             self._update_gauges()
             return report
+        if self.draft_params is not None:
+            self._spec_round(decoding, report)
+        else:
+            self._plain_step(decoding, report)
+        if ran_chunk:
+            # a prefill chunk and decode progress shared this tick:
+            # the convoy the chunking exists to break
+            self.counters["chunk_interleaves"] += 1
+            self._bump("decode.chunk_interleaves", 1)
+        self._update_gauges()
+        return report
+
+    def _plain_step(self, decoding: list, report: StepReport) -> None:
+        """One paged_decode_step over all slots; mid-prefill and free
+        slots write to the trash block and their rows are ignored."""
         write_blocks = np.zeros((self.slots_n,), np.int32)
         write_offsets = np.zeros((self.slots_n,), np.int32)
-        for index in active:
+        for index in decoding:
             position = int(self.positions[index])
             block_index = position // self.blocks.block_size
             write_blocks[index] = self.slots[index].blocks[block_index]
@@ -235,7 +336,7 @@ class DecodeEngine:
             write_offsets)
         self._note_compiles(_jit_cache_size() - before)
         next_tokens = np.asarray(next_tokens)
-        for index in active:
+        for index in decoding:
             slot = self.slots[index]
             request = slot.request
             token = int(next_tokens[index, 0])
@@ -246,8 +347,6 @@ class DecodeEngine:
             self._surface(report, request)
             if self._finished(request):
                 report.completions.append(self._complete(index))
-        self._update_gauges()
-        return report
 
     # -- admission / prefill ----------------------------------------------
 
@@ -273,32 +372,269 @@ class DecodeEngine:
                 return
             self.waiting.popleft()
             index = free[0]
-            slot = _Slot(request, granted, self._admission_seq, true_len)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:true_len] = request.prompt
+            slot = _Slot(request, granted, self._admission_seq, true_len,
+                         bucket, padded)
             self._admission_seq += 1
             self.slots[index] = slot
             self.tables[index, :] = TRASH_BLOCK
             self.tables[index, :needed] = granted
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :true_len] = request.prompt
             # a preempted request's RE-admission keeps first-attempt
             # timestamps: the caller saw its first token back then, so
             # ttft/queue_wait/prefill stats must not absorb the retry
             if request.admitted_at is None:
                 request.admitted_at = time.perf_counter()
-            before = _jit_cache_size()
-            self.pool, first = paged_prefill(
-                self.params, self.config, self.pool, padded,
-                self.tables[index], np.int32(true_len))
-            self._note_compiles(_jit_cache_size() - before)
-            first = int(first)
-            if request.first_token_at is None:
-                request.first_token_at = time.perf_counter()
-            request.generated.append(first)
-            self.positions[index] = true_len
-            self.last_tokens[index, 0] = first
             self.counters["admitted"] += 1
             report.admitted += 1
             self._bump("decode.admitted", 1)
+            if (self.prefill_chunk is not None
+                    and self.prefill_chunk < bucket):
+                # chunked: no device work at admission -- the slot's
+                # prompt is consumed one chunk per tick by
+                # _advance_prefills, interleaved with decode steps
+                continue
+            before = _jit_cache_size()
+            self.pool, first = paged_prefill(
+                self.params, self.config, self.pool, padded[None],
+                self.tables[index], np.int32(true_len))
+            self._note_compiles(_jit_cache_size() - before)
+            slot.prefill_pos = bucket
+            self._finish_prefill(index, report, int(first))
+
+    def _finish_prefill(self, index: int, report: StepReport,
+                        first: int, draft_ready: bool = False) -> None:
+        """Shared tail of monolithic and chunked prefill: record the
+        first generated token, arm the decode cursor, and bring the
+        speculative draft up to date with the prompt (chunked prefill
+        already fed the draft chunk-by-chunk: draft_ready=True)."""
+        slot = self.slots[index]
+        request = slot.request
+        slot.prefill_pos = max(slot.prefill_pos, slot.true_len)
+        if request.first_token_at is None:
+            request.first_token_at = time.perf_counter()
+        request.generated.append(first)
+        self.positions[index] = slot.true_len
+        self.last_tokens[index, 0] = first
+        if self.draft_params is not None:
+            if not draft_ready:
+                self._draft_prefill(index)
+            slot.draft_pending = [first]
+        self._surface(report, request)
+        if self._finished(request):
+            report.completions.append(self._complete(index))
+
+    def _draft_prefill(self, index: int) -> None:
+        """Bring the draft's cache up to date with a freshly prefilled
+        prompt.  The draft's own first-token opinion is DISCARDED --
+        the target's prefill output is the authoritative greedy token;
+        the draft only ever proposes."""
+        slot = self.slots[index]
+        before = _jit_cache_size()
+        self.draft_pool, _ = paged_prefill(
+            self.draft_params, self.draft_config, self.draft_pool,
+            slot.padded[None], self.draft_tables[index],
+            np.int32(slot.true_len))
+        self._note_compiles(_jit_cache_size() - before)
+        self.draft_positions[index] = slot.true_len
+
+    def _advance_prefills(self, report: StepReport) -> bool:
+        """Advance the OLDEST mid-prefill slot by ONE chunk.  One chunk
+        per tick is the SARATHI-style budget: the decode-stall bound
+        stays one chunk regardless of how many prefills were admitted
+        together (advancing every prefilling slot would multiply the
+        stall by the admission burst).  The chunk attends to the
+        already-written KV blocks of earlier chunks via the slot's
+        block table; the final chunk yields the request's first
+        generated token, bit-identical to monolithic prefill's.  With
+        a draft model, the SAME chunk range is fed through the draft's
+        pool too (a quarter-depth draft adds ~25% to the chunk cost),
+        so finishing a prompt never degenerates into one monolithic
+        draft prefill.  Returns True when a chunk ran."""
+        if self.prefill_chunk is None:
+            return False
+        block_size = self.blocks.block_size
+        order = sorted(
+            (index for index, slot in enumerate(self.slots)
+             if slot is not None and slot.prefilling),
+            key=lambda index: self.slots[index].seq)
+        if not order:
+            return False
+        index = order[0]
+        slot = self.slots[index]
+        start = slot.prefill_pos
+        remaining = slot.true_len - start
+        # the last chunk shrinks to its power-of-two bucket, so the
+        # executable count stays logarithmic in prefill_chunk
+        size = min(self.prefill_chunk,
+                   bucket_length(remaining, minimum=block_size))
+        take = min(size, remaining)
+        chunk = np.zeros((1, size), np.int32)
+        chunk[0, :take] = slot.padded[start:start + take]
+        write_blocks = np.full((size,), TRASH_BLOCK, np.int32)
+        draft_blocks = np.full((size,), TRASH_BLOCK, np.int32)
+        write_offsets = np.zeros((size,), np.int32)
+        for offset in range(size):
+            position = start + offset
+            if position < slot.true_len:
+                block_index = position // block_size
+                write_blocks[offset] = slot.blocks[block_index]
+                if self.draft_params is not None:
+                    draft_blocks[offset] = self.draft_tables[
+                        index, block_index]
+            write_offsets[offset] = position % block_size
+        before = _jit_cache_size()
+        self.pool, greedy = paged_prefill_chunk(
+            self.params, self.config, self.pool, chunk,
+            self.tables[index], np.int32(start), write_blocks,
+            write_offsets)
+        if self.draft_params is not None:
+            self.draft_pool, _ = paged_prefill_chunk(
+                self.draft_params, self.draft_config, self.draft_pool,
+                chunk, self.draft_tables[index], np.int32(start),
+                draft_blocks, write_offsets)
+        self._note_compiles(_jit_cache_size() - before)
+        self.counters["prefill_chunks"] += 1
+        self._bump("decode.prefill_chunks", 1)
+        slot.prefill_pos = start + take
+        if not slot.prefilling:
+            first = int(np.asarray(greedy)[slot.true_len - 1 - start])
+            if self.draft_params is not None:
+                self.draft_positions[index] = slot.true_len
+            self._finish_prefill(index, report, first, draft_ready=True)
+        return True
+
+    # -- speculative decoding ----------------------------------------------
+
+    def _spec_round(self, decoding: list, report: StepReport) -> None:
+        """One speculative round over all decoding slots: the draft
+        ingests the <= 2 emitted tokens it hasn't consumed and proposes
+        its first token in the same window call, extends the proposal
+        run with k-1 single steps, then the target verifies the whole
+        [last_token, p_1..p_k] window in ONE batched forward and the
+        longest greedy-matching prefix is accepted.  Greedy-exact:
+        emitted tokens are bit-identical to plain greedy decode."""
+        k = self.spec_k
+        block_size = self.blocks.block_size
+        # 1) draft ingest + first proposal.  Pending is [new last
+        # token] after a partial acceptance (the draft's own accepted
+        # proposals already live in its cache) or [p_k, bonus] after a
+        # full acceptance (p_k's K/V was never written) -- never more.
+        ingest = np.zeros((self.slots_n, 2), np.int32)
+        ingest_blocks = np.full((self.slots_n, 2), TRASH_BLOCK, np.int32)
+        ingest_offsets = np.zeros((self.slots_n, 2), np.int32)
+        pending_len = {}
+        for index in decoding:
+            pending = self.slots[index].draft_pending
+            pending_len[index] = len(pending)
+            for j, token in enumerate(pending):
+                position = int(self.draft_positions[index]) + j
+                ingest[index, j] = token
+                if position < self.max_context:
+                    ingest_blocks[index, j] = self.draft_tables[
+                        index, position // block_size]
+                    ingest_offsets[index, j] = position % block_size
+        draft_start = time.perf_counter()
+        before = _jit_cache_size()
+        self.draft_pool, draft_greedy = paged_verify_step(
+            self.draft_params, self.draft_config, self.draft_pool,
+            self.draft_tables, self.draft_positions, ingest,
+            ingest_blocks, ingest_offsets)
+        draft_greedy = np.asarray(draft_greedy)
+        proposals = np.zeros((self.slots_n, k), np.int32)
+        for index in decoding:
+            proposals[index, 0] = draft_greedy[
+                index, pending_len[index] - 1]
+            self.draft_positions[index] += pending_len[index]
+        # 2) k-1 single draft steps extend the proposal run, writing
+        # each proposal's K/V at its own position
+        current = proposals[:, 0:1].copy()
+        for run in range(1, k):
+            step_blocks = np.full((self.slots_n,), TRASH_BLOCK, np.int32)
+            step_offsets = np.zeros((self.slots_n,), np.int32)
+            for index in decoding:
+                position = int(self.draft_positions[index])
+                if position < self.max_context:
+                    step_blocks[index] = self.draft_tables[
+                        index, position // block_size]
+                    step_offsets[index] = position % block_size
+            self.draft_pool, current = paged_decode_step(
+                self.draft_params, self.draft_config, self.draft_pool,
+                self.draft_tables, self.draft_positions, current,
+                step_blocks, step_offsets)
+            current = np.asarray(current)
+            for index in decoding:
+                proposals[index, run] = current[index, 0]
+                self.draft_positions[index] += 1
+        self.spec_draft_s += time.perf_counter() - draft_start
+        # 3) target verification: [last_token, p_1..p_k] in one window
+        window = np.zeros((self.slots_n, k + 1), np.int32)
+        verify_blocks = np.full((self.slots_n, k + 1), TRASH_BLOCK,
+                                np.int32)
+        verify_offsets = np.zeros((self.slots_n, k + 1), np.int32)
+        for index in decoding:
+            slot = self.slots[index]
+            window[index, 0] = self.last_tokens[index, 0]
+            window[index, 1:] = proposals[index]
+            for j in range(k + 1):
+                position = int(self.positions[index]) + j
+                if position // block_size < len(slot.blocks):
+                    verify_blocks[index, j] = slot.blocks[
+                        position // block_size]
+                    verify_offsets[index, j] = position % block_size
+        verify_start = time.perf_counter()
+        self.pool, verified = paged_verify_step(
+            self.params, self.config, self.pool, self.tables,
+            self.positions, window, verify_blocks, verify_offsets)
+        verified = np.asarray(verified)
+        self.spec_verify_s += time.perf_counter() - verify_start
+        self._note_compiles(_jit_cache_size() - before)
+        # 4) greedy-exact acceptance: verified[j] is the target's
+        # greedy token after window position j, so draft_j is accepted
+        # iff it EQUALS verified[j-1]; the first mismatch wins a bonus
+        # token (the target's own correction) and stops the run
+        for index in decoding:
+            slot = self.slots[index]
+            request = slot.request
+            accepted = [int(verified[index, 0])]
+            for j in range(1, k + 1):
+                if int(window[index, j]) != int(verified[index, j - 1]):
+                    break
+                accepted.append(int(verified[index, j]))
+            remaining = request.max_new - len(request.generated)
+            accepted = accepted[:remaining]
+            if self.eos_id is not None:
+                for j, token in enumerate(accepted):
+                    if token == self.eos_id:
+                        accepted = accepted[:j + 1]
+                        break
+            self.counters["spec_windows"] += 1
+            self.counters["spec_drafted"] += k
+            self.counters["spec_accepted"] += len(accepted)
+            self._bump("decode.spec_drafted", k)
+            self._bump("decode.spec_accepted", len(accepted))
+            if self._registry is not None:
+                self._registry.histogram("decode.accepted_len").record(
+                    len(accepted))
+            # rejected window positions hold stale K/V past the new
+            # cursor: masked until the cursor reaches them, then
+            # overwritten before the gather -- the same invariant that
+            # covers prompt-bucket padding
+            previous = int(self.positions[index])
+            request.generated.extend(accepted)
+            request.decode_steps += 1
+            self.positions[index] = previous + len(accepted)
+            self.last_tokens[index, 0] = accepted[-1]
+            # draft bookkeeping: after a FULL acceptance the draft is
+            # missing p_k's K/V as well as the bonus token, so pending
+            # is two tokens and its cursor stays put; otherwise it
+            # rewinds over its rejected run to the new last token
+            if len(accepted) == k + 1:
+                slot.draft_pending = accepted[-2:]
+            else:
+                slot.draft_pending = accepted[-1:]
+            self.draft_positions[index] = (
+                previous + len(accepted) + 1 - len(slot.draft_pending))
             self._surface(report, request)
             if self._finished(request):
                 report.completions.append(self._complete(index))
@@ -313,12 +649,25 @@ class DecodeEngine:
             (index for index, slot in enumerate(self.slots)
              if slot is not None),
             key=lambda index: self.slots[index].seq)
+        horizon = self.spec_k if self.draft_params is not None else 0
         for index in order:
             slot = self.slots[index]
             if slot is None:
                 continue  # preempted below while growing an older slot
-            needed = (int(self.positions[index])
-                      // self.blocks.block_size) + 1
+            if slot.prefilling:
+                continue  # prompt blocks were fully granted at admission
+            # speculative rounds write a k+1 window per step, so growth
+            # covers the whole window -- but never past what the
+            # request can still EMIT (a near-complete slot must not
+            # preempt a victim for lookahead blocks no accepted token
+            # can land in) nor past max_context; overflow window
+            # positions write to the trash block instead
+            remaining = (slot.request.max_new
+                         - len(slot.request.generated))
+            slot_horizon = min(horizon, max(remaining - 1, 0))
+            target = min(int(self.positions[index]) + slot_horizon,
+                         self.max_context - 1)
+            needed = (target // self.blocks.block_size) + 1
             while len(slot.blocks) < needed:
                 granted = self.blocks.allocate(1)
                 if granted is not None:
@@ -336,12 +685,17 @@ class DecodeEngine:
     def _preempt(self, index: int) -> None:
         slot = self.slots[index]
         request = slot.request
-        _LOGGER.info("preempting slot %d (%r) after %d tokens: pool "
+        _LOGGER.info("preempting slot %d (%r) after %d tokens%s: pool "
                      "exhausted", index, request.request_id,
-                     len(request.generated))
+                     len(request.generated),
+                     (f" (mid-prefill at {slot.prefill_pos}/"
+                      f"{slot.true_len})" if slot.prefilling else ""))
         request.preemptions += 1
         # full recompute on re-admission: greedy decode regenerates the
-        # SAME tokens, and emitted_upto keeps the stream from repeating
+        # SAME tokens, and emitted_upto keeps the stream from repeating.
+        # A slot caught MID-CHUNKED-PREFILL takes the same path: its
+        # partially written KV blocks go back to the free list via
+        # _release_slot and re-admission restarts the prompt at chunk 0
         request.generated = []
         request.decode_steps = 0
         self._release_slot(index)
@@ -434,7 +788,7 @@ class DecodeEngine:
         self._registry.gauge("decode.waiting").set(len(self.waiting))
 
     def stats(self) -> dict:
-        return {
+        stats = {
             "active_slots": sum(1 for slot in self.slots
                                 if slot is not None),
             "free_blocks": self.blocks.free_count,
@@ -444,3 +798,17 @@ class DecodeEngine:
             "block_size": self.blocks.block_size,
             **self.counters,
         }
+        if self.prefill_chunk is not None:
+            stats["prefill_chunk_size"] = self.prefill_chunk
+        if self.draft_params is not None:
+            windows = max(self.counters["spec_windows"], 1)
+            spec_total = self.spec_draft_s + self.spec_verify_s
+            stats["spec_k"] = self.spec_k
+            # mean emitted tokens per verify window (ceiling: k + 1)
+            stats["accepted_len_mean"] = round(
+                self.counters["spec_accepted"] / windows, 3)
+            # share of speculative wall time spent in the draft
+            # (ingest + proposal run) vs target verification
+            stats["draft_overhead_frac"] = round(
+                self.spec_draft_s / max(spec_total, 1e-9), 3)
+        return stats

@@ -415,6 +415,8 @@ class LMGenerate(ComputeElement):
         kv_blocks = self.get_parameter("kv_blocks")
         max_context = self.get_parameter("max_context")
         eos_id = self.get_parameter("eos_id")
+        prefill_chunk = self.get_parameter("prefill_chunk_size")
+        draft_params, draft_config, spec_k = self._speculative_setup()
         self._engine = DecodeEngine(
             self.state, self.config,
             decode_slots=int(self.get_parameter("decode_slots", 4)),
@@ -422,10 +424,51 @@ class LMGenerate(ComputeElement):
             kv_blocks=int(kv_blocks) if kv_blocks else None,
             max_context=int(max_context) if max_context else None,
             eos_id=int(eos_id) if eos_id is not None else None,
+            prefill_chunk_size=(int(prefill_chunk) if prefill_chunk
+                                else None),
+            draft_params=draft_params, draft_config=draft_config,
+            spec_k=spec_k,
             registry=registry)
         self._engine_frames = {}
         self._pump_posted = False
         return self._engine
+
+    def _speculative_setup(self):
+        """`speculative` parameter -> (draft_params, draft_config, k).
+        `draft=self` shrinks the TARGET's config family (layers/d_ff
+        overrides, random-init from `seed` -- the bench/test shape);
+        `draft=<preset>` instantiates an _LM_PRESETS entry, which must
+        share the target's vocabulary.  Greedy-exact acceptance means a
+        WEAK draft only costs acceptance length, never correctness."""
+        spec = self.get_parameter("speculative")
+        if not spec:
+            return None, None, 0
+        from ..analyze.policies import parse_speculative_spec
+        parsed = parse_speculative_spec(str(spec))
+        draft = parsed["draft"]
+        if draft == "self":
+            draft_config = self.config
+        elif draft in _LM_PRESETS:
+            draft_config = _LM_PRESETS[draft]
+            if draft_config.dtype != self.config.dtype:
+                draft_config = replace(draft_config,
+                                       dtype=self.config.dtype)
+        else:
+            raise ValueError(
+                f"{self.definition.name}: speculative draft={draft!r} "
+                f"is neither 'self' nor a preset "
+                f"{sorted(_LM_PRESETS)}")
+        overrides = {}
+        if "layers" in parsed:
+            overrides["n_layers"] = parsed["layers"]
+        if "d_ff" in parsed:
+            overrides["d_ff"] = parsed["d_ff"]
+        if overrides:
+            draft_config = replace(draft_config, **overrides)
+        draft_params = init_params(
+            draft_config,
+            jax.random.PRNGKey(int(parsed.get("seed", 0))))
+        return draft_params, draft_config, parsed["k"]
 
     def _process_frame_continuous(self, stream, tokens, text):
         import time

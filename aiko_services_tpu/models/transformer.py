@@ -40,6 +40,8 @@ __all__ = [
     "generate_stream", "make_train_step", "count_params",
     "quantize_weights_int8", "quantized_param_specs",
     "init_paged_pool", "paged_prefill", "paged_decode_step",
+    "paged_prefill_chunk", "paged_verify_step",
+    "REMAT_POLICIES", "resolve_remat_policy",
 ]
 
 
@@ -565,7 +567,8 @@ def _lm_head(params: dict, config: TransformerConfig, h):
 
 def forward(params: dict, config: TransformerConfig, tokens,
             cache: dict | None = None, pos: int = 0,
-            activation_specs: bool = False, return_aux: bool = False):
+            activation_specs: bool = False, return_aux: bool = False,
+            remat_policy: str | None = None):
     """tokens (B, L) int32 -> logits (B, L, V) [+ updated cache].
 
     With cache=None this is a pure causal prefill (training / scoring).
@@ -573,11 +576,19 @@ def forward(params: dict, config: TransformerConfig, tokens,
     updated cache is returned -- the incremental-decode path.
     return_aux=True (cache-less path only) additionally returns the mean
     MoE load-balancing loss across layers (0.0 for dense FFN).
+    remat_policy (cache-less path only) wraps the per-layer scan body in
+    jax.checkpoint with the named jax.checkpoint_policies entry, trading
+    backward-pass recompute for activation memory (REMAT_POLICIES).
     """
     if return_aux and cache is not None:
         raise ValueError(
             "return_aux is only meaningful on the cache-less (training/"
             "scoring) path; with a cache forward returns (logits, cache)")
+    if remat_policy not in (None, "none") and cache is not None:
+        raise ValueError(
+            "remat_policy is only meaningful on the cache-less "
+            "(training/scoring) path; incremental decode saves nothing "
+            "by rematerializing")
     if activation_specs:
         # batch on "data", sequence on "seq" -- but only the axes the
         # ambient mesh actually has (an EP-only mesh has no "seq")
@@ -623,9 +634,16 @@ def forward(params: dict, config: TransformerConfig, tokens,
 
     aux0 = jnp.zeros((), jnp.float32)
     if cache is None:
-        (h, aux_sum), _ = jax.lax.scan(
-            lambda carry, layer: layer_step(carry, (layer, None)),
-            (h, aux0), params["layers"])
+        body = lambda carry, layer: layer_step(carry, (layer, None))  # noqa: E731
+        policy = resolve_remat_policy(remat_policy)
+        if policy is not None:
+            # remat over the scanned layer body: the standard trade --
+            # drop (policy-selected) activations in the forward pass,
+            # recompute them during backward.  prevent_cse=False is the
+            # documented setting under scan (the scan boundary already
+            # blocks the CSE that prevent_cse guards against).
+            body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+        (h, aux_sum), _ = jax.lax.scan(body, (h, aux0), params["layers"])
         new_cache = None
     else:
         (h, aux_sum), new_cache = jax.lax.scan(
@@ -812,30 +830,31 @@ def paged_prefill(params, config: TransformerConfig, pool, prompt,
     return new_pool, first
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
-def paged_decode_step(params, config: TransformerConfig, pool, tables,
-                      positions, tokens, write_blocks, write_offsets):
-    """ONE greedy decode step over ALL slots of a continuous-batching
-    engine.  tables (slots, max_blocks) int32 maps each slot's logical
-    positions onto pool blocks; positions (slots,) is each slot's next
-    write position; tokens (slots, 1) the previous greedy token;
-    write_blocks/write_offsets (slots,) the precomputed pool location
-    of this step's K/V (the engine points INACTIVE slots at the trash
-    block, so the call is shape-stable across any admit/evict
-    sequence -- zero recompiles after the first step).  Returns
-    (pool, next_tokens (slots, 1)); inactive rows are garbage the
-    engine ignores.
+def _paged_window(params, config: TransformerConfig, pool, tables,
+                  positions, tokens, write_blocks, write_offsets):
+    """Shared paged-attention step over a per-slot TOKEN WINDOW -- the
+    one traced implementation behind paged_decode_step (window 1),
+    paged_verify_step (speculative verification, window k+1), and
+    paged_prefill_chunk (chunked prefill, window = chunk bucket).
 
-    Per-slot positions (unlike forward's scalar `pos`) are the whole
-    point: slot 3 can be 400 tokens into its completion while slot 0 is
-    on its first -- the rotary phase and causal mask resolve per row."""
+    tokens (slots, W) are consumed left-to-right per slot: window
+    position i sits at absolute position positions[slot] + i, its K/V
+    lands at (write_blocks[slot, i], write_offsets[slot, i]) -- writes
+    happen for the WHOLE window before the attention gather, so later
+    window positions attend to earlier ones causally, and rows the
+    engine wants inert point their writes at the trash block.  Returns
+    (pool, greedy (slots, W)) where greedy[s, i] is the greedy token
+    AFTER consuming window positions 0..i -- exactly what W successive
+    single-token decode steps would produce, which is the bit-identity
+    contract the chunked-prefill and speculative tests pin."""
     block_size = pool["k"].shape[3]
     quantized = config.kv_dtype == "int8"
     h = _embed(params, config, tokens)
-    cos, sin = rotary_embedding(positions, config.head_dim,
+    slots, window = tokens.shape
+    q_pos = positions[:, None] + jnp.arange(window)[None, :]  # (S, W)
+    cos, sin = rotary_embedding(q_pos, config.head_dim,
                                 config.rope_theta)
-    cos, sin = cos[:, None, None, :], sin[:, None, None, :]
-    slots = tokens.shape[0]
+    cos, sin = cos[:, None], sin[:, None]        # (S, 1, W, hd/2)
     hd = config.head_dim
     repeats = config.n_heads // config.n_kv_heads
 
@@ -855,24 +874,27 @@ def paged_decode_step(params, config: TransformerConfig, pool, tables,
             layer, pool_k, pool_v = xs
         x = rms_norm(layer["attn_norm"], h, config.norm_eps)
         q = dense(layer["wq"], x).reshape(
-            slots, 1, config.n_heads, hd).transpose(0, 2, 1, 3)
+            slots, window, config.n_heads, hd).transpose(0, 2, 1, 3)
         k = dense(layer["wk"], x).reshape(
-            slots, 1, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+            slots, window, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
         v = dense(layer["wv"], x).reshape(
-            slots, 1, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+            slots, window, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
         if quantized:
             k, k_scale_new = _quantize_kv(k)
             v, v_scale_new = _quantize_kv(v)
             k_scale = k_scale.at[write_blocks, :, write_offsets, :].set(
-                k_scale_new[:, :, 0, :])
+                k_scale_new.transpose(0, 2, 1, 3))
             v_scale = v_scale.at[write_blocks, :, write_offsets, :].set(
-                v_scale_new[:, :, 0, :])
+                v_scale_new.transpose(0, 2, 1, 3))
+        # (S, H, W, d) -> (S, W, H, d): advanced indexing with the
+        # (S, W) block/offset pairs scatters every window position of
+        # every slot in one update
         pool_k = pool_k.at[write_blocks, :, write_offsets, :].set(
-            k[:, :, 0, :])
+            k.transpose(0, 2, 1, 3))
         pool_v = pool_v.at[write_blocks, :, write_offsets, :].set(
-            v[:, :, 0, :])
+            v.transpose(0, 2, 1, 3))
         if quantized:
             # dequantize into the einsum operand load, exactly as the
             # contiguous int8 cache path does
@@ -888,12 +910,12 @@ def paged_decode_step(params, config: TransformerConfig, pool, tables,
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_full,
                             preferred_element_type=jnp.float32) * scale
         k_pos = jnp.arange(k_full.shape[2])[None, None, None, :]
-        q_pos = positions[:, None, None, None]
-        logits = jnp.where(k_pos <= q_pos, logits, -1e30)
+        logits = jnp.where(k_pos <= q_pos[:, None, :, None], logits,
+                           -1e30)
         weights = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd",
                          weights.astype(v_full.dtype), v_full)
-        out = out.transpose(0, 2, 1, 3).reshape(slots, 1, -1)
+        out = out.transpose(0, 2, 1, 3).reshape(slots, window, -1)
         h = h + dense(layer["wo"], out)
         mlp_out, _ = _mlp_block(
             config, layer, rms_norm(layer["mlp_norm"], h, config.norm_eps))
@@ -914,23 +936,115 @@ def paged_decode_step(params, config: TransformerConfig, pool, tables,
     else:
         new_pool = {"k": updated[0], "v": updated[1]}
     logits = _lm_head(params, config, h)
-    next_tokens = jnp.argmax(logits[:, -1], axis=-1).astype(
-        jnp.int32)[:, None]
-    return new_pool, next_tokens
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return new_pool, greedy
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def paged_decode_step(params, config: TransformerConfig, pool, tables,
+                      positions, tokens, write_blocks, write_offsets):
+    """ONE greedy decode step over ALL slots of a continuous-batching
+    engine.  tables (slots, max_blocks) int32 maps each slot's logical
+    positions onto pool blocks; positions (slots,) is each slot's next
+    write position; tokens (slots, 1) the previous greedy token;
+    write_blocks/write_offsets (slots,) the precomputed pool location
+    of this step's K/V (the engine points INACTIVE slots at the trash
+    block, so the call is shape-stable across any admit/evict
+    sequence -- zero recompiles after the first step).  Returns
+    (pool, next_tokens (slots, 1)); inactive rows are garbage the
+    engine ignores.
+
+    Per-slot positions (unlike forward's scalar `pos`) are the whole
+    point: slot 3 can be 400 tokens into its completion while slot 0 is
+    on its first -- the rotary phase and causal mask resolve per row.
+    The window-1 instantiation of _paged_window."""
+    return _paged_window(params, config, pool, tables, positions,
+                         tokens, write_blocks[:, None],
+                         write_offsets[:, None])
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def paged_verify_step(params, config: TransformerConfig, pool, tables,
+                      positions, tokens, write_blocks, write_offsets):
+    """Speculative-decoding verification: a decode step with a TOKEN
+    WINDOW per slot instead of a single position.  tokens (slots, W)
+    holds [last emitted token, draft_1..draft_{W-1}] per slot; the
+    target consumes all W positions in ONE batched forward (the
+    weight stream is read once for W tokens -- the whole point at
+    small batch) and returns greedy (slots, W) where greedy[s, i] is
+    the target's greedy token after window position i.  The engine
+    accepts the longest prefix with draft_j == greedy[j-1], which
+    keeps emitted tokens bit-identical to plain greedy decode.
+    write_blocks/write_offsets (slots, W); overflow/inactive window
+    positions point at the trash block.  One executable per W."""
+    return _paged_window(params, config, pool, tables, positions,
+                         tokens, write_blocks, write_offsets)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def paged_prefill_chunk(params, config: TransformerConfig, pool, tokens,
+                        table_row, start, write_blocks, write_offsets):
+    """Prefill ONE request's next `C` prompt tokens into its pool
+    blocks, attending to the already-written KV blocks of earlier
+    chunks through the block table -- the SARATHI-style chunked
+    prefill that bounds per-call attention cost (C x written-so-far
+    instead of L x L) so the engine can interleave prefill progress
+    with decode steps.  tokens (1, C) is the chunk right-padded to its
+    bucket; table_row (max_blocks,) the slot's block table; start the
+    chunk's first absolute position; write_blocks/write_offsets (C,)
+    the per-token pool locations (padded tail -> trash block).
+    Returns (pool, greedy (C,)): greedy[i] is the greedy token after
+    prompt position start + i, so the FINAL chunk's entry at the true
+    prompt end is the request's first generated token, bit-identical
+    to monolithic paged_prefill's.  One executable per power-of-two
+    chunk bucket."""
+    pool, greedy = _paged_window(
+        params, config, pool, table_row[None],
+        jnp.reshape(start, (1,)), tokens, write_blocks[None],
+        write_offsets[None])
+    return pool, greedy[0]
 
 
 # -- training ---------------------------------------------------------------
 
+# Named jax.checkpoint_policies entries the remat sweep accepts
+# (make_train_step(remat_policy=), bench train `remat` knob).  "none"
+# keeps today's behavior: no jax.checkpoint wrapper at all, XLA saves
+# every scan residual.  The others trade backward-pass recompute for
+# activation memory; every policy produces BIT-IDENTICAL losses (the
+# recomputed ops are the same ops -- tested), so the sweep is purely a
+# time/memory frontier.
+REMAT_POLICIES = ("none", "everything_saveable", "nothing_saveable",
+                  "dots_saveable", "dots_with_no_batch_dims_saveable")
+
+
+def resolve_remat_policy(name: str | None):
+    """Remat-policy name -> jax.checkpoint policy callable (None =
+    don't wrap the layer body at all)."""
+    if name is None or name == "none":
+        return None
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; choose from "
+            f"{REMAT_POLICIES}")
+    return getattr(jax.checkpoint_policies, name)
+
+
 def make_train_step(config: TransformerConfig, optimizer,
-                    sharded: bool = False):
+                    sharded: bool = False,
+                    remat_policy: str | None = None):
     """Returns train_step(params, opt_state, tokens) -> (params, opt_state,
     loss).  Next-token cross-entropy in f32; jit with donation.  With
     sharded=True, activation sharding constraints (data/seq) are inserted
-    for mesh execution."""
+    for mesh execution.  remat_policy names a REMAT_POLICIES entry
+    applied to the per-layer scan body (ROADMAP #3b: the train-MFU
+    recompute-share sweep)."""
+    resolve_remat_policy(remat_policy)  # fail fast on typos
 
     def loss_fn(params, tokens):
         logits, aux = forward(params, config, tokens[:, :-1],
-                              activation_specs=sharded, return_aux=True)
+                              activation_specs=sharded, return_aux=True,
+                              remat_policy=remat_policy)
         targets = tokens[:, 1:]
         log_probs = jax.nn.log_softmax(logits, axis=-1)
         taken = jnp.take_along_axis(

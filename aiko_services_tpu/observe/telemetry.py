@@ -416,7 +416,7 @@ class PipelineTelemetry:
         when no engine has registered (the common non-LLM pipeline)."""
         if not self.registry.has_gauge("decode.active_slots"):
             return None
-        return {
+        summary = {
             "active_slots": self.registry.gauge(
                 "decode.active_slots").value,
             "free_blocks": self.registry.gauge(
@@ -428,6 +428,21 @@ class PipelineTelemetry:
             "deferred": self.registry.counter(
                 "decode.deferred_admissions").value,
         }
+        # kernel-floor features surface only when in use, so the
+        # summary shape of a plain engine stays unchanged
+        chunks = self.registry.counter("decode.prefill_chunks").value
+        if chunks:
+            summary["prefill_chunks"] = chunks
+            summary["chunk_interleaves"] = self.registry.counter(
+                "decode.chunk_interleaves").value
+        drafted = self.registry.counter("decode.spec_drafted").value
+        if drafted:
+            accepted = self.registry.counter(
+                "decode.spec_accepted").value
+            windows = max(
+                self.registry.histogram("decode.accepted_len").count, 1)
+            summary["accepted_len_mean"] = round(accepted / windows, 3)
+        return summary
 
     def _publish_snapshot(self) -> None:
         pipeline = self.pipeline

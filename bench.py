@@ -674,7 +674,12 @@ def bench_train(peak):
     n_params = count_params(params)
     optimizer = optax.adamw(1e-4)
     opt_state = optimizer.init(params)
-    train_step = make_train_step(config, optimizer)
+    # remat sweep knob (ROADMAP #3b): AIKO_BENCH_REMAT names a
+    # models.REMAT_POLICIES entry; losses are bit-identical across
+    # policies (tested), so sweeping it walks the step-time/HBM
+    # frontier toward the >= 0.45 train-MFU target
+    remat = os.environ.get("AIKO_BENCH_REMAT", "none")
+    train_step = make_train_step(config, optimizer, remat_policy=remat)
     tokens = jnp.ones((batch, seq + 1), jnp.int32)
     params, opt_state, loss = train_step(params, opt_state, tokens)  # compile
     _sync(loss)
@@ -689,6 +694,7 @@ def bench_train(peak):
     flops_per_sec = tokens_per_sec * 6 * n_params
     return {"model": f"{name} ({n_params / 1e6:.0f}M params)",
             "batch": batch, "seq_len": seq,
+            "remat": remat,
             "tokens_per_sec": round(tokens_per_sec, 1),
             "step_ms": round(elapsed / steps * 1000, 1),
             "train_mfu": _mfu(flops_per_sec, peak),
@@ -2161,6 +2167,31 @@ def bench_continuous(peak):
         "batch_fill_mean": round(float(np.mean(fill)), 3),
     }
 
+    # -- mixed long-prefill arm (convoy measurability) ---------------------
+    # a prompt 4x the standard bucket admitted mid-decode: without
+    # chunking its monolithic prefill stalls every co-scheduled decode
+    # slot for the whole kernel; with prefill_chunk_size = one bucket
+    # the stall is bounded by a chunk.  Both arms must stay
+    # bit-identical -- the convoy effect becomes a measured number the
+    # chunked_prefill config (and ROADMAP #2 disaggregation) can be
+    # judged against.
+    long_len = 4 * prompt_bucket
+    long_rng = np.random.default_rng(23)
+    long_prompt = long_rng.integers(
+        1, config.vocab_size, size=long_len).astype(np.int32)
+    convoy_shorts = [
+        long_rng.integers(1, config.vocab_size,
+                          size=prompt_lo).astype(np.int32)
+        for _ in range(slots - 1)]
+    convoy_ctx = (-(-(long_len + new_hi)
+                    // block)) * block
+    convoy = {"long_prompt": long_len, "chunk": prompt_bucket,
+              **_convoy_pair(
+                  params, config, slots=slots, block=block,
+                  chunk=prompt_bucket, short_prompts=convoy_shorts,
+                  short_new=new_hi, long_prompt=long_prompt,
+                  long_new=new_lo, max_context=convoy_ctx)}
+
     decode_flops = transformer_flops_per_token(config, prompt_hi)
     return {
         "model": f"{name} ({n_params / 1e6:.0f}M params)",
@@ -2177,6 +2208,7 @@ def bench_continuous(peak):
         "capacity_tok_s": round(capacity_tok_s, 1),
         "continuous": continuous,
         "closed_batch": closed,
+        "long_prefill": convoy,
         "goodput_speedup": round(
             continuous["goodput_tok_s"]
             / max(closed["goodput_tok_s"], 1e-9), 2),
@@ -2185,6 +2217,262 @@ def bench_continuous(peak):
             / max(continuous["ttft_p99_ms"], 1e-9), 2),
         "decode_mfu": _mfu(continuous["goodput_tok_s"] * decode_flops,
                            peak),
+    }
+
+
+# -- configs 6c/6d: kernel-floor lifts (chunked prefill, spec decode) --------
+
+def _engine_warmup(engine, lengths, max_new=2):
+    """Compile every executable the measured phase will touch: one
+    request per prompt bucket (which also walks the chunk buckets when
+    chunking is on) plus the decode/verify steps."""
+    import numpy as np
+
+    for index, length in enumerate(lengths):
+        engine.submit(("warm", index), np.ones((length,), np.int32),
+                      max_new)
+    while engine.has_work():
+        engine.step()
+
+
+def _convoy_arm(params, config, *, slots, block, chunk, short_prompts,
+                short_new, long_prompt, long_new, max_context):
+    """One convoy measurement: `slots-1` short requests decode in
+    steady state, then one long prompt is admitted mid-flight.
+    Returns (metrics, completion tokens) where decode_stall_max_ms is
+    the longest wall gap between consecutive short-request token
+    emissions after the long submission -- the convoy effect itself."""
+    import numpy as np
+
+    from aiko_services_tpu.decode import DecodeEngine
+
+    engine = DecodeEngine(params, config, decode_slots=slots,
+                          kv_block_size=block, max_context=max_context,
+                          prefill_chunk_size=chunk)
+    _engine_warmup(engine,
+                   sorted({prompt.size for prompt in short_prompts}
+                          | {long_prompt.size}))
+    compiles_before = engine.compile_count
+    outputs = {}
+    for index, prompt in enumerate(short_prompts):
+        engine.submit(("short", index), prompt, short_new)
+    for _ in range(2):
+        engine.step()  # shorts reach steady decode before the long lands
+    engine.submit("long", long_prompt, long_new)
+    submitted_at = time.perf_counter()
+    last_short_emit = submitted_at
+    max_gap = 0.0
+    long_ttft = None
+    while engine.has_work():
+        report = engine.step()
+        now = time.perf_counter()
+        for request_id, offset, _token in report.emitted:
+            if request_id == "long" and offset == 0:
+                long_ttft = now - submitted_at
+            if isinstance(request_id, tuple) and request_id[0] == "short":
+                max_gap = max(max_gap, now - last_short_emit)
+                last_short_emit = now
+        for completion in report.completions:
+            outputs[completion.request_id] = completion.tokens
+    stats = engine.stats()
+    return {
+        "decode_stall_max_ms": round(max_gap * 1000, 2),
+        "long_ttft_ms": round((long_ttft or 0.0) * 1000, 1),
+        "prefill_chunks": stats["prefill_chunks"],
+        "chunk_interleave_count": stats["chunk_interleaves"],
+        "compiles_in_window": engine.compile_count - compiles_before,
+    }, outputs
+
+
+def _convoy_pair(params, config, *, chunk, **kwargs):
+    """The monolithic/chunked A-B: both arms of _convoy_arm over the
+    same workload, the stall ratio, and the bit-identity verdict --
+    the ONE acceptance shape both the chunked_prefill config and the
+    continuous config's long_prefill arm publish."""
+    import numpy as np
+
+    arms = {}
+    arm_outputs = {}
+    for label, chunk_size in (("monolithic", None), ("chunked", chunk)):
+        arms[label], arm_outputs[label] = _convoy_arm(
+            params, config, chunk=chunk_size, **kwargs)
+    return {
+        "monolithic": arms["monolithic"],
+        "chunked": arms["chunked"],
+        "stall_speedup": round(
+            arms["monolithic"]["decode_stall_max_ms"]
+            / max(arms["chunked"]["decode_stall_max_ms"], 1e-9), 2),
+        "bit_identical": all(
+            np.array_equal(arm_outputs["monolithic"][request_id],
+                           arm_outputs["chunked"][request_id])
+            for request_id in arm_outputs["monolithic"]),
+    }
+
+
+def bench_chunked_prefill(peak):
+    """`chunked_prefill` config: the 16k-prefill kernel floor, engine
+    view (ROADMAP #3a).  A long prompt admitted into a busy engine is
+    measured twice -- monolithic paged_prefill (today's convoy: every
+    decode slot stalls for the whole quadratic kernel) vs
+    paged_prefill_chunk at a fixed chunk -- and the arms must be
+    bit-identical.  Publishes the decode-stall bound, per-chunk cost,
+    interleave counters, and zero-recompile proof; the committed
+    `aiko tune` case study (reports/tune_chunked_prefill.json) carries
+    the utilization-evidence shift at the recorded 16k operating
+    point."""
+    import jax
+    import numpy as np
+
+    from aiko_services_tpu.models import count_params, init_params
+    from aiko_services_tpu.models.configs import LLAMA32_1B, LM_TOY
+
+    config = LM_TOY if SMOKE else LLAMA32_1B
+    name = "lm_toy" if SMOKE else "llama32_1b"
+    slots = 4
+    block = 8 if SMOKE else 32
+    chunk = 32 if SMOKE else 512
+    long_len = 192 if SMOKE else 3968
+    short_len = 8 if SMOKE else 64
+    short_new = 48 if SMOKE else 256
+    long_new = 8 if SMOKE else 32
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(17)
+    short_prompts = [
+        rng.integers(1, config.vocab_size, size=short_len)
+        .astype(np.int32) for _ in range(slots - 1)]
+    long_prompt = rng.integers(1, config.vocab_size,
+                               size=long_len).astype(np.int32)
+    max_context = (-(-(long_len + max(long_new, short_len + short_new))
+                     // block)) * block
+    pair = _convoy_pair(
+        params, config, slots=slots, block=block, chunk=chunk,
+        short_prompts=short_prompts, short_new=short_new,
+        long_prompt=long_prompt, long_new=long_new,
+        max_context=max_context)
+    chunks_run = max(pair["chunked"]["prefill_chunks"], 1)
+    return {
+        "model": f"{name} ({count_params(params) / 1e6:.0f}M params)",
+        "decode_slots": slots,
+        "kv_block_size": block,
+        "prefill_chunk_size": chunk,
+        "long_prompt": long_len,
+        "short_requests": f"{slots - 1} x {short_len} (+{short_new} new)",
+        **pair,
+        "chunk_interleave_count": pair["chunked"][
+            "chunk_interleave_count"],
+        # what an equal split of the monolithic kernel across the
+        # chunk count would cost -- the per-call bound chunking targets
+        "equiv_chunk_ms": round(
+            pair["monolithic"]["long_ttft_ms"] / chunks_run, 2),
+    }
+
+
+def bench_spec_decode(peak):
+    """`spec_decode` config: the decode weight-streaming floor, engine
+    view (ROADMAP #3c).  Small-batch decode runs three arms over the
+    SAME seeded workload -- plain greedy, speculative with a
+    quarter-depth random-init draft (realistic overhead, low
+    acceptance until a trained draft ships), and speculative with the
+    target as its own draft (the acceptance CEILING: every window
+    emits k+1 tokens per weight stream) -- all bit-identical.
+    accepted_len_mean / draft_overhead_frac are the published
+    telemetry the tune case study (reports/tune_spec_decode.json)
+    turns into floor evidence."""
+    import jax
+    import numpy as np
+
+    from dataclasses import replace
+
+    from aiko_services_tpu.decode import DecodeEngine
+    from aiko_services_tpu.models import count_params, init_params
+    from aiko_services_tpu.models.configs import LLAMA32_1B, LM_TOY
+
+    config = LM_TOY if SMOKE else LLAMA32_1B
+    name = "lm_toy" if SMOKE else "llama32_1b"
+    slots = 2 if SMOKE else 4      # batch 4 = the BENCH_NOTES floor row
+    block = 8 if SMOKE else 32
+    spec_k = 4
+    requests_n = 6 if SMOKE else 24
+    prompt_lo, prompt_hi = (4, 16) if SMOKE else (32, 128)
+    max_new = 24 if SMOKE else 96
+    params = init_params(config, jax.random.PRNGKey(0))
+    draft_config = replace(config,
+                           n_layers=max(1, config.n_layers // 4),
+                           d_ff=max(64, config.d_ff // 2))
+    draft_params = init_params(draft_config, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(19)
+    workload = [
+        rng.integers(1, config.vocab_size,
+                     size=int(rng.integers(prompt_lo, prompt_hi + 1)))
+        .astype(np.int32) for _ in range(requests_n)]
+    warmup_lengths = sorted({prompt.size for prompt in workload})
+    from aiko_services_tpu.utils.padding import bucket_length
+    max_context = (-(-(bucket_length(prompt_hi, minimum=block)
+                       + max_new + spec_k) // block)) * block
+
+    def run(arm_draft_params, arm_draft_config):
+        engine = DecodeEngine(
+            params, config, decode_slots=slots, kv_block_size=block,
+            max_context=max_context,
+            draft_params=arm_draft_params,
+            draft_config=arm_draft_config,
+            spec_k=spec_k if arm_draft_params is not None else 0)
+        _engine_warmup(engine, warmup_lengths)
+        compiles_before = engine.compile_count
+        outputs = {}
+        tokens_done = 0
+        start = time.perf_counter()
+        for index, prompt in enumerate(workload):
+            engine.submit(index, prompt, max_new)
+        while engine.has_work():
+            for completion in engine.step().completions:
+                outputs[completion.request_id] = completion.tokens
+                tokens_done += completion.stats["tokens"]
+        elapsed = time.perf_counter() - start
+        stats = engine.stats()
+        block_stats = {
+            "goodput_tok_s": round(tokens_done / elapsed, 1),
+            "compiles_in_window":
+                engine.compile_count - compiles_before,
+        }
+        if arm_draft_params is not None:
+            block_stats["accepted_len_mean"] = stats[
+                "accepted_len_mean"]
+            block_stats["draft_overhead_frac"] = stats[
+                "draft_overhead_frac"]
+        return block_stats, outputs
+
+    plain, plain_outputs = run(None, None)
+    drafted, drafted_outputs = run(draft_params, draft_config)
+    ceiling, ceiling_outputs = run(params, config)
+    bit_identical = all(
+        np.array_equal(plain_outputs[index], drafted_outputs[index])
+        and np.array_equal(plain_outputs[index],
+                           ceiling_outputs[index])
+        for index in plain_outputs)
+    return {
+        "model": f"{name} ({count_params(params) / 1e6:.0f}M params)",
+        "draft": (f"{draft_config.n_layers}L/{draft_config.d_ff}ff "
+                  f"random-init "
+                  f"({count_params(draft_params) / 1e6:.0f}M params)"),
+        "decode_slots": slots,
+        "kv_block_size": block,
+        "spec_k": spec_k,
+        "requests": requests_n,
+        "prompt_len": f"uniform {prompt_lo}..{prompt_hi}",
+        "max_new": max_new,
+        "plain": plain,
+        "speculative": drafted,
+        "self_draft_ceiling": ceiling,
+        "accepted_len_mean": drafted["accepted_len_mean"],
+        "draft_overhead_frac": drafted["draft_overhead_frac"],
+        "goodput_speedup": round(
+            drafted["goodput_tok_s"]
+            / max(plain["goodput_tok_s"], 1e-9), 2),
+        "ceiling_speedup": round(
+            ceiling["goodput_tok_s"]
+            / max(plain["goodput_tok_s"], 1e-9), 2),
+        "bit_identical": bit_identical,
     }
 
 
@@ -2317,6 +2605,9 @@ _SUMMARY_FIELDS = (
     ("train", "train_mfu", "train_mfu"),
     ("serving", "coalescing_speedup", "serving_speedup"),
     ("serving", "frames_per_sec_total", "serving_fps"),
+    ("chunked_prefill", "stall_speedup", "chunk_stall_speedup"),
+    ("spec_decode", "accepted_len_mean", "spec_accept_mean"),
+    ("spec_decode", "ceiling_speedup", "spec_ceiling_speedup"),
     ("latency", "p50_ms", "latency_p50_ms"),
     ("autoscale", "time_to_healthy_warm_ms", "tth_warm_ms"),
     ("autoscale", "warm_vs_cold_speedup", "warm_speedup"),
@@ -2423,8 +2714,8 @@ def main() -> None:
 
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
-                       "longcontext,serving,continuous,autoscale,"
-                       "chaos,latency,tts,pipeline")
+                       "longcontext,serving,continuous,chunked_prefill,"
+                       "spec_decode,autoscale,chaos,latency,tts,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -2446,6 +2737,10 @@ def main() -> None:
         configs["serving"] = bench_serving(peak)
     if "continuous" in wanted:
         configs["continuous"] = bench_continuous(peak)
+    if "chunked_prefill" in wanted:
+        configs["chunked_prefill"] = bench_chunked_prefill(peak)
+    if "spec_decode" in wanted:
+        configs["spec_decode"] = bench_spec_decode(peak)
     if router_replicas is not None or "router" in wanted:
         configs["router"] = bench_router(peak, router_replicas or 2)
     if "autoscale" in wanted:

@@ -163,6 +163,153 @@ def train():
     _write_report("tune_train_step.json", report)
 
 
+def chunked_prefill():
+    """Round-15 follow-up to the longcontext study: the SAME 16k
+    operating point prefilled in 2k-token chunks (paged_prefill_chunk)
+    instead of one monolithic kernel.
+
+    Per-chunk times are derived from the two RECORDED operating points
+    by fitting t(L) = a*L + b*L^2 through (4096, 176.1 ms) and
+    (16384, 1941.8 ms) -- a is the token-linear share (projections,
+    FFN), b*L^2 the quadratic attention share the round-14 study
+    blamed for the floor.  Chunk i of C tokens then costs
+    C*(a + b*C*i): the linear work is unchanged, but each chunk's
+    attention touches only the KV written so far (C x i*C) instead of
+    the full L x L rectangle, so the summed cost AND the per-call bound
+    both drop.  Total FLOPs are the recorded count split evenly across
+    chunks (the work is the same causal attention + matmuls).  The
+    evidence the report must move: per-call cost 1941.8 ms -> one
+    bounded chunk, achieved utilization 0.0647 -> the chunked value
+    (~0.10) -- still compute-bound, but no longer AT the recorded
+    floor, which is what CI asserts (tune as the regression harness
+    for kernel work)."""
+    L, C = 16384, 2048
+    chunks = L // C
+    t_4k, t_16k = 0.1761, 1.9418
+    b = (t_16k - 4.0 * t_4k) / (16384.0 ** 2 - 4.0 * 4096.0 ** 2)
+    a = (t_4k - b * 4096.0 ** 2) / 4096.0
+    chunk_ms = [(C * (a + b * C * (i + 1))) * 1000.0
+                for i in range(chunks)]
+    flops_16k = 0.0647 * t_16k * PEAK_TFLOPS * 1e12  # recorded MFU inverted
+    definition = {
+        "name": "case_chunked_prefill",
+        "graph": ["(prefill_16k_chunked)"],
+        "elements": [
+            _element("prefill_16k_chunked", ["tokens"], ["hidden"]),
+        ],
+    }
+    config = {
+        "source": ("BENCH_DETAIL.json longcontext (round 5, v5e), "
+                   "chunked via the fitted t(L) = a*L + b*L^2 model"),
+        "model": "llama32_1b architecture, 8 layers (749M params)",
+        "batch": 1, "prompt": L, "prefill_chunk_size": C,
+        "chunks_per_prompt": chunks,
+        "fit_a_s_per_token": a, "fit_b_s_per_token2": b,
+        "monolithic_ms": t_16k * 1000.0,
+        "monolithic_mfu": 0.0647,
+        "chunked_total_ms": round(sum(chunk_ms), 1),
+        "peak_tflops_assumed": PEAK_TFLOPS,
+    }
+    # one frame = the 16k prompt = `chunks` successive chunk calls
+    events = _events([("prefill_16k_chunked", ms) for ms in chunk_ms],
+                     calls=12)
+    path = os.path.join(HERE, "chunked_prefill_16k.json")
+    _write(path, chrome_trace_document(events, metadata=trace_metadata(
+        definition_document=definition, config=config,
+        config_name="chunked_prefill")))
+    static = {
+        "prefill_16k_chunked": {
+            "rows": 1, "bytes_in": C * 4,
+            "bytes_out": C * 2048 * 2,
+            "param_bytes": int(749e6 * 2),
+            "flops": flops_16k / chunks},
+    }
+    report = run_tune(path, slo_spec=SloSpec.parse("throughput"),
+                      static_costs=static)
+    _write_report("tune_chunked_prefill.json", report)
+
+
+def spec_decode():
+    """The decode weight-streaming floor (BENCH_NOTES: llama32_1b 481
+    tok/s at batch 4; 8.5 ms/step with the 2.47 GB weight stream +
+    fixed decode-loop work dominating) vs greedy-exact speculative
+    decoding at the acceptance ceiling.
+
+    The per-step cost model is fitted from the two RECORDED batch
+    points (8.5 ms at 4 tokens/step, 28.2 ms at 128 with int8 KV):
+    t(n) = f + c*n with f = 7.86 ms of batch-independent work (weight
+    stream + loop) and c = 0.159 ms per token-position.  A verify
+    window of k+1 = 5 positions x 4 slots pays f ONCE for 20
+    positions; the quarter-depth draft costs 0.25*t per call (ingest
+    window + k-1 singles).  At full acceptance every round emits 20
+    tokens -- the floor stops being per-token weight streaming and
+    becomes prefill-shaped compute, which shows up as achieved
+    utilization rising ~2x while the classification stays
+    compute-bound.  CI asserts the verify element's utilization
+    evidence exceeds the plain decode element's."""
+    f_ms, c_ms = 7.86, 0.159    # fitted from 8.5@4 and 28.2@128
+    slots, k = 4, 4
+    window = k + 1
+    flops_per_token = 2.47e9    # ~2 FLOPs/param, 1.24B params
+    # plain arm: one generate_stream chunk of 8 steps per call
+    steps_per_call = 8
+    decode_call_ms = steps_per_call * (f_ms + c_ms * slots)
+    decode_tokens_per_call = steps_per_call * slots
+    # speculative arm at the acceptance ceiling: 8 rounds per call;
+    # each round = target verify (f + 20c) + quarter-depth draft
+    # (ingest window of 2 x slots + (k-1) single steps)
+    verify_ms = f_ms + c_ms * slots * window
+    draft_ms = 0.25 * ((f_ms + c_ms * slots * 2)
+                       + (k - 1) * (f_ms + c_ms * slots))
+    spec_call_ms = steps_per_call * (verify_ms + draft_ms)
+    spec_tokens_per_call = steps_per_call * slots * window
+    definition = {
+        "name": "case_spec_decode",
+        "graph": ["(decode_step (verify_step))"],
+        "elements": [
+            _element("decode_step", ["tokens"], ["plain"]),
+            _element("verify_step", ["plain"], ["spec"]),
+        ],
+    }
+    config = {
+        "source": ("BENCH_NOTES round 5/6 decode rows (8.5 ms/step at "
+                   "batch 4; 28.2 ms at batch 128) fitted as "
+                   "t(n) = f + c*n"),
+        "model": "llama32_1b (1.24B params)",
+        "batch": slots, "spec_k": k,
+        "fit_fixed_ms": f_ms, "fit_per_token_ms": c_ms,
+        "accepted_len_mean": float(window),  # acceptance ceiling
+        "draft_overhead_frac": round(
+            draft_ms / (verify_ms + draft_ms), 3),
+        "plain_tok_s": round(
+            decode_tokens_per_call / decode_call_ms * 1000.0, 1),
+        "spec_tok_s": round(
+            spec_tokens_per_call / spec_call_ms * 1000.0, 1),
+        "peak_tflops_assumed": PEAK_TFLOPS,
+    }
+    events = _events([("decode_step", decode_call_ms),
+                      ("verify_step", spec_call_ms)], calls=20)
+    path = os.path.join(HERE, "spec_decode.json")
+    _write(path, chrome_trace_document(events, metadata=trace_metadata(
+        definition_document=definition, config=config,
+        config_name="spec_decode")))
+    static = {
+        "decode_step": {
+            "rows": 1, "bytes_in": slots * 4,
+            "bytes_out": slots * steps_per_call * 4,
+            "param_bytes": int(2.47e9),
+            "flops": decode_tokens_per_call * flops_per_token},
+        "verify_step": {
+            "rows": 1, "bytes_in": slots * window * 4,
+            "bytes_out": slots * window * 4,
+            "param_bytes": int(2.47e9),
+            "flops": spec_tokens_per_call * flops_per_token},
+    }
+    report = run_tune(path, slo_spec=SloSpec.parse("throughput"),
+                      static_costs=static)
+    _write_report("tune_spec_decode.json", report)
+
+
 def _write_report(name, report):
     os.makedirs(REPORTS, exist_ok=True)
     path = os.path.join(REPORTS, name)
@@ -177,3 +324,5 @@ def _write_report(name, report):
 if __name__ == "__main__":
     longcontext()
     train()
+    chunked_prefill()
+    spec_decode()

@@ -286,6 +286,52 @@ def test_preempted_request_does_not_reemit_streamed_tokens(tiny_model):
         assert len(pairs) == 12
 
 
+def test_preemption_mid_chunked_prefill_frees_partial_blocks(tiny_model):
+    """A slot preempted BETWEEN prefill chunks discards its partially
+    written KV blocks back to the free list (no leak), and the
+    re-admitted request still completes bit-identical -- the
+    youngest-first policy extended to mid-prefill victims."""
+    params, config = tiny_model
+    # 2-position blocks: slot 0 (2-token prompt, 18 new) grows a block
+    # every other step while slot 1 chunks a 16-token prompt 2 tokens
+    # per tick (8 chunks, 8 blocks granted up front).  Capacity 11
+    # exhausts on slot 0's growth around tick 6 -- mid-way through
+    # slot 1's chunk sequence -- so the youngest (mid-prefill) slot is
+    # preempted with blocks partially written.
+    engine = DecodeEngine(params, config, decode_slots=2, kv_block_size=2,
+                          kv_blocks=12, prefill_chunk_size=2)
+    prompts = {0: np.arange(1, 3, dtype=np.int32),
+               1: np.arange(11, 27, dtype=np.int32)}
+    engine.submit(0, prompts[0], 18)
+    engine.step()  # admit + prefill slot 0 (monolithic: bucket == chunk)
+    engine.submit(1, prompts[1], 4)
+    mid_prefill_preempted = False
+    done = {}
+    steps = 0
+    while engine.has_work():
+        slot1 = next((slot for slot in engine.slots
+                      if slot is not None
+                      and slot.request.request_id == 1), None)
+        before = engine.counters["preempted"]
+        report = engine.step()
+        if (slot1 is not None and slot1.prefilling
+                and engine.counters["preempted"] > before):
+            mid_prefill_preempted = True
+        for completion in report.completions:
+            done[completion.request_id] = completion
+        steps += 1
+        assert steps < 4000
+    assert engine.counters["preempted"] >= 1
+    assert mid_prefill_preempted, (
+        "scenario no longer preempts a mid-prefill slot; retune pool")
+    # every block returned: a leaked partial grant would show here
+    assert engine.stats()["free_blocks"] == engine.blocks.capacity
+    for index, prompt in prompts.items():
+        np.testing.assert_array_equal(
+            done[index].tokens,
+            reference(params, config, prompt, done[index].tokens.size))
+
+
 def test_cancel_frees_slots_and_waiting(tiny_model):
     params, config = tiny_model
     engine = DecodeEngine(params, config, decode_slots=1, kv_block_size=8)
@@ -314,6 +360,244 @@ def test_engine_int8_kv_matches_quantized_generate():
     for index, prompt in enumerate(prompts):
         np.testing.assert_array_equal(
             done[index].tokens, reference(params, config, prompt, 6))
+
+
+# -- chunked prefill (paged_prefill_chunk) ----------------------------------
+
+
+class TestChunkedPrefill:
+    """ISSUE 11 tentpole (a): chunked prefill must be bit-identical to
+    the monolithic paged_prefill path at every chunk size, and must
+    actually interleave prefill progress with decode steps."""
+
+    PROMPT_LENGTHS = (5, 21, 3, 33, 7, 12)
+
+    def _run(self, params, config, chunk, max_new=8, **kwargs):
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(1, 64, size=n).astype(np.int32)
+                   for n in self.PROMPT_LENGTHS]
+        engine = DecodeEngine(params, config, decode_slots=3,
+                              kv_block_size=8,
+                              prefill_chunk_size=chunk, **kwargs)
+        for index, prompt in enumerate(prompts):
+            engine.submit(index, prompt, max_new)
+        return prompts, engine, drain(engine)
+
+    @pytest.mark.parametrize("chunk", (8, 16, 64))
+    def test_chunked_matches_monolithic_bitwise(self, tiny_model, chunk):
+        """Chunk sizes {1 block, 1 bucket, full prompt}: completions
+        equal the closed-batch reference (and therefore the monolithic
+        engine, which the other tests pin to the same oracle)."""
+        params, config = tiny_model
+        prompts, engine, done = self._run(params, config, chunk)
+        for index, prompt in enumerate(prompts):
+            np.testing.assert_array_equal(
+                done[index].tokens, reference(params, config, prompt, 8))
+        if chunk < 64:
+            assert engine.counters["prefill_chunks"] > 0
+
+    def test_chunked_int8_kv_matches_monolithic(self):
+        config = TransformerConfig(**{**TINY, "kv_dtype": "int8"})
+        params = init_params(config, jax.random.PRNGKey(0))
+        prompts, engine, done = self._run(params, config, 8)
+        for index, prompt in enumerate(prompts):
+            np.testing.assert_array_equal(
+                done[index].tokens, reference(params, config, prompt, 8))
+
+    def test_prefill_interleaves_with_decode(self, tiny_model):
+        """The convoy-breaking property itself: while a long prompt is
+        mid-prefill, co-scheduled decode slots keep emitting tokens --
+        counted by decode.chunk_interleaves."""
+        params, config = tiny_model
+        engine = DecodeEngine(params, config, decode_slots=2,
+                              kv_block_size=8, prefill_chunk_size=8)
+        engine.submit("short", np.arange(1, 4, dtype=np.int32), 24)
+        engine.step()  # short prompt admitted and decoding
+        engine.submit("long", np.arange(1, 34, dtype=np.int32), 4)
+        interleaved_tokens = 0
+        steps = 0
+        while engine.has_work():
+            long_slot = next(
+                (slot for slot in engine.slots if slot is not None
+                 and slot.request.request_id == "long"), None)
+            mid_prefill = long_slot is not None and long_slot.prefilling
+            report = engine.step()
+            if mid_prefill:
+                interleaved_tokens += sum(
+                    1 for rid, _, _ in report.emitted if rid == "short")
+            steps += 1
+            assert steps < 2000
+        assert interleaved_tokens > 0, (
+            "no short-request tokens decoded during the long prefill")
+        assert engine.counters["chunk_interleaves"] > 0
+
+    def test_chunk_size_coerced_to_block_multiple(self, tiny_model):
+        params, config = tiny_model
+        engine = DecodeEngine(params, config, decode_slots=1,
+                              kv_block_size=8, prefill_chunk_size=3)
+        assert engine.prefill_chunk == 8  # pow2 floored at block size
+
+
+# -- speculative decoding (paged_verify_step) -------------------------------
+
+
+class TestSpeculativeDecoding:
+    """ISSUE 11 tentpole (b): greedy-exact speculative decoding --
+    draft proposes k, target verifies k+1 positions in one window,
+    emitted tokens bit-identical to plain greedy decode."""
+
+    def _models(self):
+        config = TransformerConfig(**TINY)
+        params = init_params(config, jax.random.PRNGKey(0))
+        draft_config = TransformerConfig(**{**TINY, "n_layers": 1})
+        draft_params = init_params(draft_config, jax.random.PRNGKey(3))
+        return params, config, draft_params, draft_config
+
+    def test_spec_decode_storm_bit_identical(self):
+        """The satellite suite: a seeded 20-request engine storm with
+        ragged prompt/completion lengths under speculation matches
+        plain greedy bit-for-bit, with zero recompiles after warmup."""
+        params, config, draft_params, draft_config = self._models()
+        engine = DecodeEngine(params, config, decode_slots=3,
+                              kv_block_size=8, draft_params=draft_params,
+                              draft_config=draft_config, spec_k=3)
+        rng = np.random.default_rng(42)
+        # warmup: every prefill bucket + the spec-round executables
+        for index, length in enumerate((3, 9, 17)):
+            engine.submit(("warm", index),
+                          np.arange(1, length + 1, dtype=np.int32), 5)
+        drain(engine)
+        warm = engine.compile_count
+        workload = {}
+        done = {}
+        submitted = 0
+        while submitted < 20:
+            for _ in range(int(rng.integers(1, 4))):
+                length = int(rng.integers(1, 21))
+                prompt = rng.integers(1, 64, size=length).astype(np.int32)
+                max_new = int(rng.integers(1, 10))
+                workload[submitted] = (prompt, max_new)
+                engine.submit(submitted, prompt, max_new)
+                submitted += 1
+            for _ in range(int(rng.integers(1, 5))):
+                for completion in engine.step().completions:
+                    done[completion.request_id] = completion
+        done.update(drain(engine))
+        assert len(done) >= 20
+        for index, (prompt, max_new) in workload.items():
+            np.testing.assert_array_equal(
+                done[index].tokens,
+                reference(params, config, prompt, max_new))
+        assert engine.compile_count == warm, (
+            f"speculative storm recompiled "
+            f"{engine.compile_count - warm} signatures")
+        assert engine.counters["spec_windows"] > 0
+
+    def test_self_draft_accepts_full_window(self):
+        """draft == target: every proposal matches, so each verify
+        window emits k+1 tokens (modulo the final clipped window) --
+        the acceptance accounting sanity check."""
+        params, config, _, _ = self._models()
+        engine = DecodeEngine(params, config, decode_slots=1,
+                              kv_block_size=8, draft_params=params,
+                              draft_config=config, spec_k=3)
+        engine.submit(0, np.arange(1, 6, dtype=np.int32), 16)
+        done = drain(engine)
+        np.testing.assert_array_equal(
+            done[0].tokens, reference(params, config,
+                                      np.arange(1, 6), 16))
+        stats = engine.stats()
+        assert stats["accepted_len_mean"] > 3.0  # ceiling k+1 = 4
+        assert 0.0 < stats["draft_overhead_frac"] < 1.0
+
+    def test_spec_int8_kv_matches_plain(self):
+        config = TransformerConfig(**{**TINY, "kv_dtype": "int8"})
+        params = init_params(config, jax.random.PRNGKey(0))
+        draft_config = TransformerConfig(
+            **{**TINY, "kv_dtype": "int8", "n_layers": 1})
+        draft_params = init_params(draft_config, jax.random.PRNGKey(3))
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 64, size=n).astype(np.int32)
+                   for n in (6, 11)]
+        engine = DecodeEngine(params, config, decode_slots=2,
+                              kv_block_size=8, draft_params=draft_params,
+                              draft_config=draft_config, spec_k=2)
+        for index, prompt in enumerate(prompts):
+            engine.submit(index, prompt, 6)
+        done = drain(engine)
+        for index, prompt in enumerate(prompts):
+            np.testing.assert_array_equal(
+                done[index].tokens, reference(params, config, prompt, 6))
+
+    def test_spec_eos_truncates_accepted_run(self, tiny_model):
+        """An EOS inside an accepted window stops the run exactly where
+        plain greedy decode would."""
+        params, config = tiny_model
+        prompt = np.arange(1, 6, dtype=np.int32)
+        plain = reference(params, config, prompt, 12)
+        cut = next(k for k in range(1, 12)
+                   if plain[k] not in plain[:k])
+        eos = int(plain[cut])
+        engine = DecodeEngine(params, config, decode_slots=1,
+                              kv_block_size=8, eos_id=eos,
+                              draft_params=params, draft_config=config,
+                              spec_k=4)
+        engine.submit(0, prompt, 12)
+        completion = drain(engine)[0]
+        assert completion.stats["tokens"] == cut + 1
+        np.testing.assert_array_equal(completion.tokens[:cut + 1],
+                                      plain[:cut + 1])
+        assert (completion.tokens[cut + 1:] == eos).all()
+
+    def test_spec_with_chunked_prefill_storm(self):
+        """Acceptance criterion: BOTH features on, a seeded admission
+        storm decodes bit-identically with zero engine recompiles
+        after warmup."""
+        params, config, draft_params, draft_config = self._models()
+        engine = DecodeEngine(params, config, decode_slots=3,
+                              kv_block_size=8, prefill_chunk_size=8,
+                              draft_params=draft_params,
+                              draft_config=draft_config, spec_k=3)
+        rng = np.random.default_rng(7)
+        for index, length in enumerate((3, 9, 17, 33)):
+            engine.submit(("warm", index),
+                          np.arange(1, length + 1, dtype=np.int32), 3)
+        drain(engine)
+        warm = engine.compile_count
+        workload = {}
+        done = {}
+        submitted = 0
+        while submitted < 20:
+            for _ in range(int(rng.integers(1, 4))):
+                length = int(rng.integers(1, 40))
+                prompt = rng.integers(1, 64, size=length).astype(np.int32)
+                max_new = int(rng.integers(1, 8))
+                workload[submitted] = (prompt, max_new)
+                engine.submit(submitted, prompt, max_new)
+                submitted += 1
+            for _ in range(int(rng.integers(1, 5))):
+                for completion in engine.step().completions:
+                    done[completion.request_id] = completion
+        done.update(drain(engine))
+        for index, (prompt, max_new) in workload.items():
+            np.testing.assert_array_equal(
+                done[index].tokens,
+                reference(params, config, prompt, max_new))
+        assert engine.compile_count == warm
+        assert engine.counters["prefill_chunks"] > 0
+        assert engine.counters["spec_windows"] > 0
+
+    def test_spec_rejects_mismatched_vocab_and_partial_config(self):
+        params, config, draft_params, draft_config = self._models()
+        from dataclasses import replace
+        bad = replace(draft_config, vocab_size=32)
+        with pytest.raises(ValueError, match="vocab"):
+            DecodeEngine(params, config, draft_params=draft_params,
+                         draft_config=bad)
+        with pytest.raises(ValueError, match="BOTH"):
+            DecodeEngine(params, config, draft_params=draft_params)
+        with pytest.raises(ValueError, match="draft model"):
+            DecodeEngine(params, config, spec_k=3)
 
 
 # -- LMGenerate `continuous: true` pipeline integration ---------------------
@@ -384,6 +668,57 @@ def test_continuous_pipeline_bit_identical_to_closed_batch():
     stats = lm_element.engine_stats()
     assert stats["completed"] == sum(frame.shape[0] for frame in frames)
     assert stats["active_slots"] == 0 and stats["waiting"] == 0
+
+
+def test_continuous_pipeline_with_kernel_floor_features_bit_identical():
+    """The AIKO405 surface end-to-end: `prefill_chunk_size` +
+    `speculative: draft=self;k=3;layers=...` through LMGenerate produce
+    completions bit-identical to the plain closed-batch path, and the
+    engine telemetry (accepted-length mean, chunk counters) reaches
+    engine_stats()."""
+    rng = np.random.default_rng(21)
+    frames = [rng.integers(1, 300, size=(2, 17)).astype(np.int32)
+              for _ in range(2)]
+    closed, _, _ = run_lm_frames({}, frames)
+    continuous, _, lm_element = run_lm_frames(
+        {"continuous": True, "decode_slots": 3, "kv_block_size": 8,
+         "prefill_chunk_size": 8,
+         "speculative": "draft=self;k=3;layers=1;seed=9"},
+        frames)
+    for (_, closed_frame, closed_out), (_, _, cont_out) in zip(
+            closed, continuous):
+        np.testing.assert_array_equal(
+            np.asarray(closed_out["generated"]),
+            np.asarray(cont_out["generated"]))
+    stats = lm_element.engine_stats()
+    assert stats["prefill_chunks"] > 0
+    assert stats["spec_windows"] > 0
+    assert stats["accepted_len_mean"] >= 1.0
+    assert 0.0 <= stats["draft_overhead_frac"] <= 1.0
+    assert stats["prefill_chunk_size"] == 8 and stats["spec_k"] == 3
+
+
+def test_speculative_parameter_rejects_bad_spec():
+    """A malformed `speculative` spec fails the first continuous frame
+    with the same GrammarError message offline lint reports (AIKO405),
+    not a cryptic engine crash."""
+    from aiko_services_tpu.analyze.policies import (
+        check_decode_parameters, parse_speculative_spec)
+
+    with pytest.raises(ValueError, match="speculative"):
+        parse_speculative_spec("draft=self")          # missing k
+    with pytest.raises(ValueError, match="unknown"):
+        parse_speculative_spec("draft=self;k=2;warp=9")
+    with pytest.raises(ValueError, match="draft=self"):
+        parse_speculative_spec("draft=toy;k=2;layers=1")
+    problems = check_decode_parameters(
+        {"continuous": True, "speculative": "draft=self;k=0"})
+    assert any(code == "AIKO405" for code, _ in problems)
+    # both features demand the continuous engine
+    problems = check_decode_parameters(
+        {"speculative": "draft=self;k=2", "prefill_chunk_size": 16})
+    codes = [code for code, _ in problems]
+    assert codes.count("AIKO405") == 2
 
 
 def test_continuous_pipeline_zero_recompiles_after_warmup():

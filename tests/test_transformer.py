@@ -85,6 +85,53 @@ def test_train_step_reduces_loss():
     assert np.isfinite(losses).all()
 
 
+class TestRematPolicySweep:
+    """ROADMAP #3b groundwork: make_train_step(remat_policy=) accepts
+    named jax.checkpoint_policies entries.  Remat only changes WHEN
+    activations are (re)computed, never WHAT is computed, so every
+    policy must produce bit-identical losses -- the sweep is purely a
+    step-time/HBM frontier the bench `remat` knob walks."""
+
+    POLICIES = ("none", "nothing_saveable", "dots_saveable",
+                "dots_with_no_batch_dims_saveable")
+
+    def test_policies_produce_bit_identical_losses(self):
+        tokens = (jax.random.randint(jax.random.PRNGKey(5), (2, 17),
+                                     0, 256).astype(jnp.int32))
+        optimizer = optax.adamw(1e-3)
+        losses = {}
+        for policy in self.POLICIES:
+            params = _params()
+            opt_state = optimizer.init(params)
+            step = make_train_step(CONFIG, optimizer,
+                                   remat_policy=policy)
+            trail = []
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state, tokens)
+                trail.append(np.asarray(loss))
+            losses[policy] = trail
+        baseline = losses["none"]
+        for policy in self.POLICIES[1:]:
+            np.testing.assert_array_equal(
+                np.asarray(losses[policy]), np.asarray(baseline),
+                err_msg=f"remat_policy={policy} drifted from baseline")
+
+    def test_unknown_policy_fails_fast(self):
+        from aiko_services_tpu.models import REMAT_POLICIES
+        with pytest.raises(ValueError, match="remat_policy"):
+            make_train_step(CONFIG, optax.adam(1e-3),
+                            remat_policy="dots_savable")  # typo
+        assert "nothing_saveable" in REMAT_POLICIES
+
+    def test_remat_rejected_on_decode_path(self):
+        params = _params()
+        cache = init_cache(CONFIG, 1, max_len=8)
+        tokens = jnp.ones((1, 1), jnp.int32)
+        with pytest.raises(ValueError, match="cache-less"):
+            forward(params, CONFIG, tokens, cache=cache, pos=0,
+                    remat_policy="nothing_saveable")
+
+
 def test_sharded_train_step_on_mesh():
     """Full TP+FSDP+DP+SP train step over the 8-device mesh: params sharded
     by param_specs, batch sharded on data, runs and stays finite."""
