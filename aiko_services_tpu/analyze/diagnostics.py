@@ -67,6 +67,7 @@ RULES = {
     "AIKO405": ("error", "invalid continuous-batching decode parameter"),
     "AIKO406": ("error", "invalid autoscale policy spec"),
     "AIKO407": ("error", "invalid gateway HA/journal policy spec"),
+    "AIKO408": ("error", "invalid prefill/decode disaggregation spec"),
     # -- AIKO5xx: profile-guided tuning (tune/) --------------------------
     "AIKO501": ("error", "invalid tune SLO/directive spec"),
     "AIKO502": ("warning", "tune recommendation not applicable to the "

@@ -14,11 +14,11 @@ from .diagnostics import AnalysisReport, Diagnostic
 from .grammar import Field, GrammarError, split_directives
 
 __all__ = ["run_policy_pass", "check_gateway_policy",
-           "check_autoscale_policy", "check_faults_spec",
-           "check_journal_policy", "check_decode_parameters",
-           "check_tune_spec", "parse_speculative_spec",
-           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS",
-           "SPECULATIVE_FIELDS"]
+           "check_autoscale_policy", "check_disagg_policy",
+           "check_faults_spec", "check_journal_policy",
+           "check_decode_parameters", "check_tune_spec",
+           "parse_speculative_spec", "FAULT_TOLERANCE_FIELDS",
+           "DECODE_FIELDS", "DISAGG_FIELDS", "SPECULATIVE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -47,6 +47,17 @@ DECODE_FIELDS = {
     "prefill_chunk_size": Field("int", minimum=1),
     "speculative": Field("str"),
 }
+
+# Element-level disaggregation parameters (LMGenerate `role` /
+# `adopt_timeout`): checked as AIKO408 -- the same rule family as the
+# gateway's `disagg` policy spec, because both describe the SAME
+# prefill/decode split and must fail the same way offline and at
+# construction.
+DISAGG_FIELDS = {
+    "role": Field("str", choices=("prefill", "decode")),
+    "adopt_timeout": Field("float", minimum=0.0),
+}
+
 
 # The `speculative` directive (LMGenerate parameter, `;`-separated
 # key=value through the shared grammar core): greedy-exact speculative
@@ -92,12 +103,16 @@ def parse_speculative_spec(spec) -> dict:
     return parsed
 
 
-def check_decode_parameters(parameters: dict) -> list:
+def check_decode_parameters(parameters: dict,
+                            disagg_scope: bool = True) -> list:
     """(code, message) problems in one element's continuous-batching
     parameter set: per-field type/bounds, plus the cross-field pool
     sanity check (a pool that cannot hold even one completion admits
     nothing -- every submit would raise, which should be a lint
-    finding, not a serving-time surprise)."""
+    finding, not a serving-time surprise).  `disagg_scope=False` skips
+    the AIKO408 role/adopt_timeout rules: `role` is a generic
+    parameter name, and only elements that actually interpret it as a
+    disagg pool (LMGenerate) may be judged by its vocabulary."""
     problems = []
     clean = {}
     for key, field in DECODE_FIELDS.items():
@@ -107,6 +122,15 @@ def check_decode_parameters(parameters: dict) -> list:
             clean[key] = field.coerce("decode", key, parameters[key])
         except ValueError as error:
             problems.append(("AIKO405", str(error)))
+    if disagg_scope:
+        for key, field in DISAGG_FIELDS.items():
+            if key not in parameters:
+                continue
+            try:
+                clean[key] = field.coerce("disagg", key,
+                                          parameters[key])
+            except ValueError as error:
+                problems.append(("AIKO408", str(error)))
     if "speculative" in clean:
         try:
             parse_speculative_spec(clean["speculative"])
@@ -117,10 +141,34 @@ def check_decode_parameters(parameters: dict) -> list:
     # misconfiguration worth failing offline
     for feature in ("speculative", "prefill_chunk_size"):
         if feature in clean and not clean.get("continuous"):
+            if clean.get("role") == "prefill" \
+                    and feature == "prefill_chunk_size":
+                continue  # the prefill engine chunks without decoding
             problems.append((
                 "AIKO405",
                 f"{feature} requires continuous=true (the closed-batch "
                 f"path ignores it)"))
+    # disagg cross-field rules: a decode-pool element IS the continuous
+    # engine (adoption rewrites slot block tables); a prefill-pool
+    # element never decodes, so the continuous/speculative knobs on it
+    # are dead configuration worth failing offline
+    role = clean.get("role")
+    if role == "decode" and not clean.get("continuous"):
+        problems.append((
+            "AIKO408",
+            "role=decode requires continuous=true (adoption needs the "
+            "slot engine)"))
+    if role == "prefill":
+        for feature in ("continuous", "speculative"):
+            if clean.get(feature):
+                problems.append((
+                    "AIKO408",
+                    f"role=prefill does not decode; drop {feature}"))
+    if "adopt_timeout" in clean and role != "decode":
+        problems.append((
+            "AIKO408",
+            "adopt_timeout only applies to role=decode (the adopting "
+            "side of the KV migration)"))
     if problems or not clean.get("continuous"):
         return problems
     block_size = clean.get("kv_block_size", 16)
@@ -212,6 +260,23 @@ def check_tune_spec(spec) -> list:
     return check(spec)
 
 
+def check_disagg_policy(spec) -> list:
+    """(code, message) problems in a prefill/decode disaggregation
+    spec (gateway `disagg` parameter, or a replica definition's
+    `disagg: "role=..."`).  Same shape as check_gateway_policy: the
+    per-directive grammar check as AIKO408, then the REAL
+    DisaggPolicy.parse so cross-field constraints (role= is
+    replica-side only) fail offline exactly as at construction."""
+    from ..serve.disagg import DISAGG_GRAMMAR, DisaggPolicy
+    problems = DISAGG_GRAMMAR.check(spec, value_code="AIKO408")
+    if not problems:
+        try:
+            DisaggPolicy.parse(spec)
+        except ValueError as error:
+            problems.append(("AIKO408", str(error)))
+    return problems
+
+
 def check_autoscale_policy(spec) -> list:
     """(code, message) problems in an elastic-fleet autoscale spec.
     Same shape as check_gateway_policy: the per-directive grammar
@@ -232,10 +297,10 @@ def run_policy_pass(definition) -> AnalysisReport:
     report = AnalysisReport(passes_run=["policy"])
     name = definition.name
     on_error = _on_error_field()
-    scopes = ([("", definition.parameters)]
-              + [(element.name, element.parameters)
+    scopes = ([("", definition.parameters, None)]
+              + [(element.name, element.parameters, element)
                  for element in definition.elements])
-    for element_name, parameters in scopes:
+    for element_name, parameters, element in scopes:
         parameters = parameters or {}
         fields = dict(FAULT_TOLERANCE_FIELDS)
         fields["on_error"] = on_error
@@ -248,8 +313,18 @@ def run_policy_pass(definition) -> AnalysisReport:
                 report.add(Diagnostic(
                     "AIKO401", str(error), definition=name,
                     element=element_name))
-        if any(key in parameters for key in DECODE_FIELDS):
-            for code, message in check_decode_parameters(parameters):
+        # `role`/`adopt_timeout` are only disagg vocabulary on elements
+        # that interpret them (LMGenerate); a Detector with
+        # role="primary" must not trip AIKO408
+        disagg_scope = (
+            element is not None
+            and (element.deploy_local or {}).get("class_name")
+            == "LMGenerate")
+        triggers = (tuple(DECODE_FIELDS)
+                    + (tuple(DISAGG_FIELDS) if disagg_scope else ()))
+        if any(key in parameters for key in triggers):
+            for code, message in check_decode_parameters(
+                    parameters, disagg_scope=disagg_scope):
                 report.add(Diagnostic(code, message, definition=name,
                                       element=element_name))
     faults_spec = (definition.parameters or {}).get("faults")
@@ -266,6 +341,13 @@ def run_policy_pass(definition) -> AnalysisReport:
     if autoscale_spec:
         for code, message in check_autoscale_policy(autoscale_spec):
             report.add(Diagnostic(code, message, definition=name))
+    # `disagg` pins a REPLICA's pool role; `disagg_policy` is a
+    # gateway-side spec embedded next to the definition (both AIKO408)
+    for parameter in ("disagg", "disagg_policy"):
+        disagg_spec = (definition.parameters or {}).get(parameter)
+        if disagg_spec:
+            for code, message in check_disagg_policy(disagg_spec):
+                report.add(Diagnostic(code, message, definition=name))
     journal_spec = (definition.parameters or {}).get("journal_policy")
     if journal_spec:
         for code, message in check_journal_policy(journal_spec):

@@ -7,6 +7,11 @@
 #               prefill (prefill_chunk_size) interleaved with decode,
 #               and greedy-exact speculative decoding (draft model +
 #               spec_k verify windows)
+#   disagg.py   PrefillEngine -- the prefill half of a disaggregated
+#               fleet: prompt kernels into a private paged pool, KV
+#               blocks exported as a transfer-plane descriptor tree
+#               that DecodeEngine.adopt_request fetches into a free
+#               slot over the transfer plane (no re-prefill)
 #
 # Device kernels live in models/transformer.py (init_paged_pool,
 # paged_prefill, paged_prefill_chunk, paged_decode_step,
@@ -15,6 +20,9 @@
 
 from .blocks import BlockManager, TRASH_BLOCK      # noqa: F401
 from .engine import Completion, DecodeEngine, StepReport  # noqa: F401
+from .disagg import (                              # noqa: F401
+    HANDOFF_SCHEMA, PrefillEngine, fetch_kv_blocks)
 
 __all__ = ["BlockManager", "TRASH_BLOCK", "Completion", "DecodeEngine",
-           "StepReport"]
+           "HANDOFF_SCHEMA", "PrefillEngine", "StepReport",
+           "fetch_kv_blocks"]

@@ -216,7 +216,9 @@ class DecodeEngine:
                          "deferred_admissions": 0, "cancelled": 0,
                          "compiles": 0, "prefill_chunks": 0,
                          "chunk_interleaves": 0, "spec_windows": 0,
-                         "spec_drafted": 0, "spec_accepted": 0}
+                         "spec_drafted": 0, "spec_accepted": 0,
+                         "adopted": 0, "adopt_fallbacks": 0,
+                         "kv_migrated_bytes": 0}
         self._update_gauges()
 
     # -- submission --------------------------------------------------------
@@ -253,6 +255,110 @@ class DecodeEngine:
             request_id=request_id, prompt=prompt, max_new=max_new,
             submitted_at=time.perf_counter()))
         self._update_gauges()
+
+    def adopt_request(self, request_id, handoff: dict,
+                      timeout: float | None = None) -> StepReport:
+        """Adopt a remotely prefilled request MID-FLIGHT: fetch the
+        handoff's KV blocks over the transfer plane (one batched
+        connection per peer), rewrite a free slot's block table to the
+        granted blocks, and continue greedy decode from the prompt end
+        -- no re-prefill, int8 KV carried through unchanged, tokens
+        bit-identical to a co-located prefill+decode (the transferred
+        K/V are exact copies, and the writes-before-gather invariant
+        covers the last block's padding tail exactly as it covers
+        local prefill's).
+
+        NEVER loses the request: a fetch failure/timeout, a block-size
+        mismatch, a full slot array, or an exhausted pool all FALL
+        BACK to a plain submit() -- a local re-prefill through the
+        ordinary admission path (decode.adopt_fallbacks counts it).
+        Returns a StepReport carrying the first token's emission (and
+        the completion, when max_new == 1)."""
+        from .disagg import fetch_kv_blocks
+
+        report = StepReport()
+        prompt = np.asarray(handoff["prompt"], np.int32).reshape(-1)
+        max_new = int(handoff["max_new"])
+        true_len = int(handoff.get("true_len", prompt.size))
+
+        def fallback(reason: str) -> StepReport:
+            _LOGGER.info("adopt %r fell back to local re-prefill: %s",
+                         request_id, reason)
+            self.counters["adopt_fallbacks"] += 1
+            self._bump("decode.adopt_fallbacks", 1)
+            self.submit(request_id, prompt, max_new)
+            return report
+
+        if int(handoff.get("block_size", 0)) != self.blocks.block_size:
+            return fallback(
+                f"block_size {handoff.get('block_size')} != pool's "
+                f"{self.blocks.block_size}")
+        free = [index for index, slot in enumerate(self.slots)
+                if slot is None]
+        if not free:
+            return fallback("no free slot")
+        worst = max(self._bucket(true_len), true_len + max_new)
+        if worst > self.max_context:
+            raise ValueError(
+                f"{request_id}: prompt {true_len} + max_new {max_new} "
+                f"exceeds max_context {self.max_context}")
+        needed = self.blocks.blocks_for(true_len)
+        if len(handoff.get("kv_blocks") or []) != needed:
+            return fallback(
+                f"handoff carries {len(handoff.get('kv_blocks') or [])}"
+                f" blocks, prompt needs {needed}")
+        granted = self.blocks.allocate(needed)
+        if granted is None:
+            return fallback("pool exhausted")
+        adopt_start = time.perf_counter()
+        try:
+            leaves = fetch_kv_blocks(handoff, timeout=timeout)
+        except (KeyError, ValueError) as error:
+            # TransferError subclasses ValueError; expired keys raise
+            # KeyError -- either way the prompt re-prefills locally
+            self.blocks.free(granted)
+            return fallback(f"KV fetch failed: {error}")
+        migrated = 0
+        indices = np.asarray(granted)
+        for name, stacked in leaves.items():
+            if name not in self.pool:
+                self.blocks.free(granted)
+                return fallback(f"handoff leaf {name!r} not in pool "
+                                f"(kv_dtype mismatch?)")
+            migrated += stacked.nbytes
+            self.pool[name] = self.pool[name].at[:, indices].set(stacked)
+        # slot bookkeeping identical to a local prefill's end state
+        request = _Request(
+            request_id=request_id, prompt=prompt, max_new=max_new,
+            submitted_at=(adopt_start
+                          - float(handoff.get("queue_wait_s", 0.0))
+                          - float(handoff.get("prefill_s", 0.0))))
+        request.admitted_at = adopt_start
+        bucket = self._bucket(true_len)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:true_len] = prompt
+        index = free[0]
+        slot = _Slot(request, granted, self._admission_seq, true_len,
+                     bucket, padded)
+        self._admission_seq += 1
+        slot.prefill_pos = true_len
+        self.slots[index] = slot
+        self.tables[index, :] = TRASH_BLOCK
+        self.tables[index, :needed] = granted
+        self._finish_prefill(index, report,
+                             int(handoff["first_token"]))
+        adopt_ms = (time.perf_counter() - adopt_start) * 1000.0
+        self.counters["adopted"] += 1
+        self.counters["kv_migrated_bytes"] += migrated
+        self.counters["admitted"] += 1
+        report.admitted += 1
+        self._bump("decode.adopted", 1)
+        self._bump("decode.admitted", 1)
+        self._bump("decode.kv_migrated_bytes", migrated)
+        if self._registry is not None:
+            self._registry.histogram("decode.adopt_ms").record(adopt_ms)
+        self._update_gauges()
+        return report
 
     def cancel(self, predicate) -> int:
         """Drop every request whose request_id satisfies `predicate`

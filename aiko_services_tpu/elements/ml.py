@@ -304,10 +304,14 @@ class LMGenerate(ComputeElement):
             tokens[row, width - len(ids):] = ids  # left-pad
         return tokens, prompts
 
-    def process_frame(self, stream, tokens=None, text=None):
+    def process_frame(self, stream, tokens=None, text=None,
+                      handoff=None):
         import contextlib
+        if self.disagg_role(stream) == "prefill":
+            return self._process_frame_prefill(stream, tokens, text)
         if self.engine_managed(stream):
-            return self._process_frame_continuous(stream, tokens, text)
+            return self._process_frame_continuous(stream, tokens, text,
+                                                  handoff)
         self._ensure_ready()
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
         formatted = None
@@ -398,6 +402,13 @@ class LMGenerate(ComputeElement):
         from ..utils import truthy
         return truthy(self.get_parameter("continuous", False, stream))
 
+    def disagg_role(self, stream=None) -> str:
+        """Disaggregated-fleet role: "" (co-located, the default),
+        "prefill" (prompt kernels only -- frames return a KV handoff
+        instead of tokens), or "decode" (the continuous engine, which
+        ADOPTS incoming handoffs instead of re-prefilling)."""
+        return str(self.get_parameter("role", "", stream) or "")
+
     def _ensure_engine(self):
         engine = getattr(self, "_engine", None)
         if engine is not None:
@@ -470,22 +481,158 @@ class LMGenerate(ComputeElement):
             jax.random.PRNGKey(int(parsed.get("seed", 0))))
         return draft_params, draft_config, parsed["k"]
 
-    def _process_frame_continuous(self, stream, tokens, text):
+    # -- disaggregated prefill (decode/disagg.py PrefillEngine) ------------
+    #
+    # `role: prefill` turns the element into the prompt half of a
+    # split fleet: frames run paged_prefill / paged_prefill_chunk into
+    # a private paged pool and the response carries a KV HANDOFF (one
+    # JSON-safe record per row: prompt + first token + `__tensorref__`
+    # descriptors for the prompt's KV blocks) instead of tokens.  A
+    # decode-role replica adopts the handoff into a free slot and
+    # continues greedy decode bit-identically -- no re-prefill.
+
+    def _ensure_prefill_engine(self):
+        engine = getattr(self, "_prefill_engine", None)
+        if engine is not None:
+            return engine
+        self._ensure_ready()
+        if self.mesh is not None or self.config.sequence_parallel:
+            raise ValueError(
+                f"{self.definition.name}: role=prefill runs the paged "
+                f"prefill engine single-device; drop the sharding mesh "
+                f"/ sequence_parallel")
+        from ..decode import PrefillEngine
+        telemetry = getattr(self.pipeline, "telemetry", None)
+        registry = (telemetry.registry if telemetry is not None
+                    and telemetry.enabled else None)
+        max_context = self.get_parameter("max_context")
+        prefill_chunk = self.get_parameter("prefill_chunk_size")
+        self._prefill_engine = PrefillEngine(
+            self.state, self.config,
+            kv_block_size=int(self.get_parameter("kv_block_size", 16)),
+            max_context=int(max_context) if max_context else None,
+            prefill_chunk_size=(int(prefill_chunk) if prefill_chunk
+                                else None),
+            registry=registry)
+        self._prefill_frames = {}
+        self._prefill_pump_posted = False
+        return self._prefill_engine
+
+    def _process_frame_prefill(self, stream, tokens, text):
         import time
-        engine = self._ensure_engine()
-        formatted = None
+        engine = self._ensure_prefill_engine()
         if tokens is None:
             if text is None:
                 raise ValueError("LMGenerate needs tokens or text input")
-            tokens, formatted = self._encode_prompts(stream, text)
+            tokens, _ = self._encode_prompts(stream, text)
         tokens = np.asarray(tokens, np.int32)
         if tokens.ndim == 1:
             tokens = tokens[None]
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
         key = (stream.stream_id, stream.current_frame_id)
+        self._prefill_frames[key] = {
+            "rows": tokens.shape[0], "done": {},
+            "submitted_at": time.perf_counter(),
+        }
+        try:
+            for row in range(tokens.shape[0]):
+                engine.submit(key + (row,), tokens[row], max_new)
+        except ValueError:
+            self._prefill_frames.pop(key, None)
+            engine.cancel(lambda rid: rid[:2] == key)
+            raise
+        self._schedule_prefill_pump()
+        return StreamEvent.PENDING, None
+
+    def _schedule_prefill_pump(self):
+        if not getattr(self, "_prefill_pump_posted", False):
+            self._prefill_pump_posted = True
+            self.post_message("_prefill_pump", [])
+
+    def _prefill_pump(self):
+        self._prefill_pump_posted = False
+        engine = getattr(self, "_prefill_engine", None)
+        if engine is None:
+            return
+        try:
+            for handoff in engine.step():
+                self._finish_prefill_handoff(handoff)
+        except Exception as error:
+            self._fail_prefill_frames(error)
+            return
+        if engine.has_work():
+            self._schedule_prefill_pump()
+
+    def _finish_prefill_handoff(self, handoff):
+        import time
+        stream_id, frame_id, row = handoff["request_id"]
+        key = (stream_id, frame_id)
+        entry = self._prefill_frames.get(key)
+        if entry is None:
+            return  # stream destroyed mid-prefill
+        record = dict(handoff)
+        record["request_id"] = row  # peer-local identity, JSON-safe
+        entry["done"][row] = record
+        if len(entry["done"]) < entry["rows"]:
+            return
+        outputs = {"handoff": [entry["done"][r]
+                               for r in range(entry["rows"])]}
+        self.pipeline.post_message("process_frame_response", [
+            {"stream_id": stream_id, "frame_id": frame_id,
+             "node": self.definition.name,
+             "time": time.perf_counter() - entry["submitted_at"]},
+            outputs])
+        del self._prefill_frames[key]
+
+    def _fail_prefill_frames(self, error):
+        """Prefill engine failure: release every PENDING frame with an
+        error response (the stream applies its on_error policy; a
+        disagg gateway degrades the frame to a local decode-side
+        prefill) and rebuild the engine lazily."""
+        _LOGGER.error("%s: prefill engine failed, releasing %d frames: "
+                      "%s", self.definition.name,
+                      len(getattr(self, "_prefill_frames", {})), error)
+        frames = getattr(self, "_prefill_frames", {})
+        self._prefill_frames = {}
+        self._prefill_engine = None
+        for stream_id, frame_id in frames:
+            self.pipeline.post_message("process_frame_response", [
+                {"stream_id": stream_id, "frame_id": frame_id,
+                 "node": self.definition.name, "event": "error"}, {}])
+
+    def prefill_stats(self) -> dict | None:
+        """Live prefill-engine occupancy; None before the first
+        prefill frame."""
+        engine = getattr(self, "_prefill_engine", None)
+        return None if engine is None else engine.stats()
+
+    def _process_frame_continuous(self, stream, tokens, text,
+                                  handoff=None):
+        import time
+        engine = self._ensure_engine()
+        formatted = None
+        handoffs = None
+        if handoff:
+            # disaggregated hop 2: adopt the prefill pool's KV blocks
+            # instead of re-prefilling the prompt locally
+            handoffs = handoff if isinstance(handoff, list) else [handoff]
+            rows = len(handoffs)
+        else:
+            if tokens is None:
+                if text is None:
+                    raise ValueError(
+                        "LMGenerate needs tokens, text, or handoff "
+                        "input")
+                tokens, formatted = self._encode_prompts(stream, text)
+            tokens = np.asarray(tokens, np.int32)
+            if tokens.ndim == 1:
+                tokens = tokens[None]
+            rows = tokens.shape[0]
+        max_new = int(self.get_parameter("max_new_tokens", 32, stream))
+        key = (stream.stream_id, stream.current_frame_id)
         from ..utils import truthy
         self._engine_frames[key] = {
-            "rows": tokens.shape[0], "done": {},
+            "rows": rows, "done": {},
             "formatted": formatted, "max_new": max_new,
             "submitted_at": time.perf_counter(),
             "stream_tokens": truthy(self.get_parameter(
@@ -499,14 +646,40 @@ class LMGenerate(ComputeElement):
         # (e.g. prompt + max_new over max_context) must not leak the
         # frame entry or strand already-queued sibling rows
         try:
-            for row in range(tokens.shape[0]):
-                engine.submit(key + (row,), tokens[row], max_new)
+            if handoffs is not None:
+                timeout = self.get_parameter("adopt_timeout", None,
+                                             stream)
+                adopt_s = time.perf_counter()
+                for row, record in enumerate(handoffs):
+                    report = engine.adopt_request(
+                        key + (row,), record,
+                        timeout=(float(timeout) if timeout else None))
+                    for rid, _offset, token in report.emitted:
+                        self._buffer_streamed_token(rid, token)
+                    for completion in report.completions:
+                        self._finish_request(completion)
+                self._note_adopt_span(stream, key,
+                                      time.perf_counter() - adopt_s)
+            else:
+                for row in range(rows):
+                    engine.submit(key + (row,), tokens[row], max_new)
         except ValueError:
-            del self._engine_frames[key]
+            self._engine_frames.pop(key, None)
             engine.cancel(lambda rid: rid[:2] == key)
             raise
         self._schedule_pump()
         return StreamEvent.PENDING, None
+
+    def _note_adopt_span(self, stream, key, elapsed_s: float) -> None:
+        """Record the adopt (KV-migration) span on the frame trace so
+        `aiko tune` can attribute migration-bound waits distinctly from
+        slot-queue waits."""
+        telemetry = getattr(self.pipeline, "telemetry", None)
+        if telemetry is None or not telemetry.enabled:
+            return
+        telemetry.record_adopt(
+            self.pipeline.streams.get(key[0]), key[1],
+            self.definition.name, elapsed_s)
 
     def _schedule_pump(self):
         """At most ONE pump message in flight: each tick runs one fused
@@ -635,6 +808,12 @@ class LMGenerate(ComputeElement):
                         if key[0] == stream_id]:
                 del self._engine_frames[key]
             engine.cancel(lambda rid: rid[0] == stream_id)
+        prefill = getattr(self, "_prefill_engine", None)
+        if prefill is not None:
+            for key in [key for key in self._prefill_frames
+                        if key[0] == stream_id]:
+                del self._prefill_frames[key]
+            prefill.cancel(lambda rid: rid[0] == stream_id)
         return super().stop_stream(stream, stream_id)
 
     def engine_stats(self) -> dict | None:
@@ -665,7 +844,8 @@ class LMGenerate(ComputeElement):
                 or self.tokenizer is not None
                 or truthy(self.get_parameter(
                     "stream_tokens", False, stream))
-                or self.engine_managed(stream)):
+                or self.engine_managed(stream)
+                or self.disagg_role(stream)):
             return None
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
 
@@ -692,6 +872,10 @@ class LMGenerate(ComputeElement):
         self.configure()
         if self.config.sequence_parallel:
             return None  # sp decode needs an ambient mesh to trace
+        if self.disagg_role():
+            # a disagg element's output contract is a handoff record /
+            # adopted completion, not the pure generate() shape
+            return None
         max_new = int(self.get_parameter("max_new_tokens", 32))
         config = self.config
 

@@ -49,6 +49,14 @@ class GatewayTelemetry:
         self.pool_size = registry.gauge("gateway.pool_size")
         self.scale_ups = registry.counter("gateway.scale_up")
         self.scale_downs = registry.counter("gateway.scale_down")
+        # disaggregated serving (serve/disagg.py): prefill-hop routing
+        # plus the two outcomes -- a KV handoff forwarded to the decode
+        # pool, or a degradation to local prefill (pool empty, prefill
+        # error, or a parked frame whose handoff keys would expire)
+        self.prefill_routed = registry.counter("gateway.prefill_routed")
+        self.kv_migrations = registry.counter("gateway.kv_migrations")
+        self.prefill_fallbacks = registry.counter(
+            "gateway.prefill_fallbacks")
         self.time_to_healthy = registry.histogram(
             "gateway.time_to_healthy_ms")
         self.warm_spawns = registry.counter("gateway.spawns_warm")
@@ -127,6 +135,10 @@ class GatewayTelemetry:
             "scale_ups": self.scale_ups.value,
             "scale_downs": self.scale_downs.value,
         }
+        if self.prefill_routed.value:
+            summary["prefill_routed"] = self.prefill_routed.value
+            summary["kv_migrations"] = self.kv_migrations.value
+            summary["prefill_fallbacks"] = self.prefill_fallbacks.value
         if self.latency.count:
             summary["admit_latency_p50_ms"] = round(
                 self.latency.quantile(0.5) * 1000, 3)

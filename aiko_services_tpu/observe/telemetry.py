@@ -256,6 +256,23 @@ class PipelineTelemetry:
                   "preemptions": stats.get("preemptions"),
                   "tokens": stats.get("tokens")}))
 
+    def record_adopt(self, stream, frame_id, node: str,
+                     elapsed_s: float) -> None:
+        """A disaggregated decode element adopted a frame's migrated
+        KV blocks (fetch + pool scatter): its own span category so
+        `aiko tune` classifies migration-bound elements distinctly
+        from queue-bound ones."""
+        if not self.enabled:
+            return
+        self.registry.histogram("adopt_s:" + node).record(elapsed_s)
+        frame = (stream.frames.get(frame_id)
+                 if stream is not None else None)
+        trace = frame.trace if frame is not None else None
+        if trace is not None:
+            trace.events.append(
+                ("X", f"adopt:{node}", "engine",
+                 now_us() - elapsed_s * 1e6, elapsed_s * 1e6, None))
+
     # -- fault tolerance ---------------------------------------------------
 
     def record_retry(self, frame, node: str, attempt: int,
@@ -406,6 +423,18 @@ class PipelineTelemetry:
         decode = self.decode_summary()
         if decode is not None:
             summary["decode"] = decode
+        exports = self.registry.counter("prefill.exports").value
+        if exports:
+            # a prefill-pool replica (LMGenerate role=prefill): its
+            # export/queue numbers are what the disagg autoscaler's
+            # queue-pressure signal and the dashboard read
+            summary["prefill"] = {
+                "exports": exports,
+                "exported_bytes": self.registry.counter(
+                    "prefill.exported_bytes").value,
+                "chunks": self.registry.counter(
+                    "prefill.chunks").value,
+            }
         return summary
 
     def decode_summary(self) -> dict | None:
@@ -442,6 +471,16 @@ class PipelineTelemetry:
             windows = max(
                 self.registry.histogram("decode.accepted_len").count, 1)
             summary["accepted_len_mean"] = round(accepted / windows, 3)
+        adopted = self.registry.counter("decode.adopted").value
+        fallbacks = self.registry.counter(
+            "decode.adopt_fallbacks").value
+        if adopted or fallbacks:
+            # disaggregated decode pool: KV migrations in, and the
+            # degradations the prefill-pool autoscaler watches
+            summary["adopted"] = adopted
+            summary["adopt_fallbacks"] = fallbacks
+            summary["kv_migrated_bytes"] = self.registry.counter(
+                "decode.kv_migrated_bytes").value
         return summary
 
     def _publish_snapshot(self) -> None:

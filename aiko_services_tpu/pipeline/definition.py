@@ -125,14 +125,22 @@ def _parse_ports(ports, element_name, direction) -> list:
     for port in ports:
         _require(isinstance(port, dict) and "name" in port,
                  f"{element_name}: each {direction} port needs a 'name'")
-        parsed.append({"name": port["name"],
-                       "type": port.get("type", "any"),
-                       # micro-batch contract: batched outputs are split
-                       # per frame by leading-row range; "batched": false
-                       # marks an output as shared by every coalesced
-                       # frame even when its leading dim happens to match
-                       # the batch size (e.g. an NxN affinity matrix)
-                       "batched": bool(port.get("batched", True))})
+        record = {"name": port["name"],
+                  "type": port.get("type", "any"),
+                  # micro-batch contract: batched outputs are split
+                  # per frame by leading-row range; "batched": false
+                  # marks an output as shared by every coalesced
+                  # frame even when its leading dim happens to match
+                  # the batch size (e.g. an NxN affinity matrix)
+                  "batched": bool(port.get("batched", True))}
+        # "optional": true inputs bind to None when the frame carries
+        # no such key instead of erroring the frame (the disagg decode
+        # element's `handoff` port: present on migrated frames, absent
+        # on direct ones).  Only recorded when set, so existing
+        # definitions round-trip byte-identically
+        if port.get("optional"):
+            record["optional"] = True
+        parsed.append(record)
     return parsed
 
 

@@ -250,6 +250,20 @@ class Pipeline(Actor):
             "stream_count": 0,
             "frame_count": 0,
         })
+        # disaggregated serving: a `disagg: "role=prefill"` definition
+        # parameter pins this replica's pool; the `role` share key is
+        # how a discovering gateway learns pool membership (local
+        # attaches read it directly).  Parse errors are left to the
+        # construction lint (AIKO408) below
+        disagg_spec = (definition.parameters or {}).get("disagg")
+        if disagg_spec:
+            from ..serve.disagg import DisaggPolicy
+            try:
+                disagg_role = DisaggPolicy.parse(disagg_spec).role
+            except ValueError:
+                disagg_role = None
+            if disagg_role:
+                self.share["role"] = disagg_role
         # telemetry: metrics registry + frame tracer + periodic export
         # (pipeline parameter "telemetry: false" disables ALL per-frame
         # instrument writes -- the latency operating point)
@@ -1890,6 +1904,9 @@ class Pipeline(Actor):
         for port in definition.input:
             swag_key = definition.map_in.get(port["name"], port["name"])
             if swag_key not in swag:
+                if port.get("optional"):
+                    inputs[port["name"]] = None
+                    continue
                 raise KeyError(swag_key)
             inputs[port["name"]] = swag[swag_key]
         return inputs
@@ -2076,34 +2093,56 @@ class Pipeline(Actor):
         from the tree (or unknown here) fall back to their own setup()
         untouched -- a partial hand-off is better than none."""
         from .tpu_element import ComputeElement
-        from .transfer import TENSOR_REF_KEY, fetch
+        from .transfer import TENSOR_REF_KEY, fetch_many
         from ..observe.metrics import get_registry
 
         metrics = get_registry()
 
-        def materialize(node):
+        # two passes: collect every descriptor leaf first, then fetch
+        # the whole tree through fetch_many -- ONE connection per
+        # producing peer instead of one TCP handshake per leaf (the
+        # hand-off of a transformer's parameter tree is dozens of
+        # leaves from the same sibling)
+        pending: list = []
+
+        def collect(node):
             if isinstance(node, dict):
                 if TENSOR_REF_KEY in node:
-                    array = fetch(node[TENSOR_REF_KEY])
-                    metrics.counter("warm_start.imported_bytes").inc(
-                        array.nbytes)
-                    return array
-                return {key: materialize(value)
-                        for key, value in node.items()}
-            if isinstance(node, tuple) and hasattr(node, "_fields"):
-                # namedtuple pytree node (optimizer states etc.):
-                # the constructor takes fields positionally
-                return type(node)(*(materialize(value)
-                                    for value in node))
+                    pending.append(node[TENSOR_REF_KEY])
+                    return
+                for value in node.values():
+                    collect(value)
+                return
             if isinstance(node, (list, tuple)):
-                return type(node)(materialize(value) for value in node)
+                for value in node:
+                    collect(value)
+                return
             if node is None:
-                return None
+                return
             # leaves were all replaced by descriptor markers at export:
             # anything else is a container this walk cannot rebuild
             raise ValueError(
                 f"import_weights: unsupported state container "
                 f"{type(node).__name__} (dict/list/tuple pytrees only)")
+
+        def materialize(node, fetched):
+            if isinstance(node, dict):
+                if TENSOR_REF_KEY in node:
+                    array = next(fetched)
+                    metrics.counter("warm_start.imported_bytes").inc(
+                        array.nbytes)
+                    return array
+                return {key: materialize(value, fetched)
+                        for key, value in node.items()}
+            if isinstance(node, tuple) and hasattr(node, "_fields"):
+                # namedtuple pytree node (optimizer states etc.):
+                # the constructor takes fields positionally
+                return type(node)(*(materialize(value, fetched)
+                                    for value in node))
+            if isinstance(node, (list, tuple)):
+                return type(node)(materialize(value, fetched)
+                                  for value in node)
+            return None
 
         installed = []
         start = time.perf_counter()
@@ -2114,7 +2153,10 @@ class Pipeline(Actor):
                                 "ComputeElement %r; skipped",
                                 self.name, name)
                 continue
-            element.restore_state(materialize(tree))
+            pending = []
+            collect(tree)
+            fetched = iter(fetch_many(pending))
+            element.restore_state(materialize(tree, fetched))
             installed.append(name)
         metrics.histogram("warm_start.import_s").record(
             time.perf_counter() - start)

@@ -14,12 +14,17 @@
 # ICI/DCN); the transfer plane covers pipeline-stage hand-off between
 # framework Processes on one or many hosts.
 #
-# Protocol (one request per connection):
+# Protocol (request/response, PIPELINED on one connection):
 #   client -> server: 32-byte hex key + "\n"
 #   server -> client: 8-byte big-endian length + raw array bytes
 #                     (length 0 = unknown/expired key)
 # dtype/shape travel in the descriptor, so the wire carries nothing but
-# the buffer.
+# the buffer.  A client may send further keys on the same connection
+# after reading each response (fetch_many batches a whole descriptor
+# tree -- a warm-start weight hand-off, a KV-block migration -- into
+# ONE connection per peer instead of one TCP handshake per leaf); a
+# client that closes after one response gets the historical
+# one-request-per-connection behavior.
 #
 # Failure contract: fetch() raises TransferError (a ValueError) on any
 # network fault and KeyError on expired/consumed keys -- both inside the
@@ -41,7 +46,7 @@ from ..faults import get_injector
 from ..observe.metrics import get_registry
 
 __all__ = [
-    "TensorTransferServer", "TransferError", "fetch",
+    "TensorTransferServer", "TransferError", "fetch", "fetch_many",
     "get_transfer_server", "transfer_enabled", "transfer_threshold",
     "reset_transfer_server",
 ]
@@ -247,36 +252,47 @@ class TensorTransferServer:
     def _handle(self, conn: socket.socket):
         try:
             conn.settimeout(transfer_timeout())
-            request = b""
-            while not request.endswith(b"\n"):
-                chunk = conn.recv(_KEY_BYTES + 1 - len(request))
-                if not chunk:
-                    return
-                request += chunk
-            key = request.strip().decode("ascii", "replace")
-            now = time.monotonic()
-            with self._lock:
-                entry = self._store.get(key)
-                if entry is not None and entry[0] < now:
-                    del self._store[key]
-                    entry = None
-                elif entry is not None:
-                    # first fetch starts the linger clock; later fetches
-                    # within the window reuse the same (shortened) deadline
-                    deadline = min(entry[0], now + transfer_linger())
-                    self._store[key] = (deadline, entry[1])
-            if entry is None:
-                conn.sendall(_HEADER.pack(0))
-                return
-            _, array = entry
-            try:  # zero-copy stream of the contiguous buffer
-                view = memoryview(array).cast("B")
-            except (TypeError, ValueError, BufferError):
-                view = array.tobytes()  # exotic dtypes without buffers
-            conn.sendall(_HEADER.pack(array.nbytes))
-            conn.sendall(view)
-            get_registry().counter(
-                "transfer.served_bytes").inc(array.nbytes)
+            # the pipelined protocol writes a small header before each
+            # buffer; Nagle + delayed ACK would turn every round trip
+            # into a ~40 ms stall
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            get_registry().counter("transfer.connections").inc()
+            # pipelined request loop: serve keys until the client closes
+            # (a single-fetch client closes after one response, which is
+            # the historical contract; fetch_many keeps the connection
+            # open across a whole descriptor tree)
+            while True:
+                request = b""
+                while not request.endswith(b"\n"):
+                    chunk = conn.recv(_KEY_BYTES + 1 - len(request))
+                    if not chunk:
+                        return
+                    request += chunk
+                key = request.strip().decode("ascii", "replace")
+                now = time.monotonic()
+                with self._lock:
+                    entry = self._store.get(key)
+                    if entry is not None and entry[0] < now:
+                        del self._store[key]
+                        entry = None
+                    elif entry is not None:
+                        # first fetch starts the linger clock; later
+                        # fetches within the window reuse the same
+                        # (shortened) deadline
+                        deadline = min(entry[0], now + transfer_linger())
+                        self._store[key] = (deadline, entry[1])
+                if entry is None:
+                    conn.sendall(_HEADER.pack(0))
+                    continue
+                _, array = entry
+                try:  # zero-copy stream of the contiguous buffer
+                    view = memoryview(array).cast("B")
+                except (TypeError, ValueError, BufferError):
+                    view = array.tobytes()  # exotic dtypes w/o buffers
+                conn.sendall(_HEADER.pack(array.nbytes))
+                conn.sendall(view)
+                get_registry().counter(
+                    "transfer.served_bytes").inc(array.nbytes)
         except OSError:
             pass
         finally:
@@ -324,6 +340,8 @@ def fetch(descriptor: dict, timeout: float | None = None,
             with socket.create_connection(address,
                                           timeout=timeout) as conn:
                 conn.settimeout(timeout)
+                conn.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
                 conn.sendall(descriptor["key"].encode("ascii") + b"\n")
                 header = _recv_exact(conn, _HEADER.size)
                 (length,) = _HEADER.unpack(header)
@@ -350,6 +368,90 @@ def fetch(descriptor: dict, timeout: float | None = None,
         time.perf_counter() - fetch_start)
     array = np.frombuffer(raw, dtype=_resolve_dtype(descriptor["dtype"]))
     return array.reshape(descriptor["shape"])
+
+
+def fetch_many(descriptors, timeout: float | None = None,
+               retries: int | None = None) -> list:
+    """Fetch a whole batch of descriptors with ONE connection per peer,
+    pipelining key requests over it -- the descriptor-tree fast path
+    (warm-start weight hand-off, prefill->decode KV migration).  A
+    per-leaf fetch() pays a TCP handshake per tensor; at KV-block
+    granularity that is dozens of round trips per prompt, and the
+    handshake -- not the bytes -- dominates.  Here a prompt's KV
+    migrates in one connection per producing peer.
+
+    Returns arrays in input order.  Raises KeyError on the first
+    consumed/expired key (never retried) and TransferError after the
+    retry budget; a connection cut mid-batch retries only the keys not
+    yet received.  `transfer.batched_fetches` counts connections this
+    path opened; `transfer.fetches`/`fetched_bytes` count per-leaf as
+    on the single-fetch path, so the two reconcile."""
+    if timeout is None:
+        timeout = transfer_timeout()
+    if retries is None:
+        retries = transfer_retries()
+    metrics = get_registry()
+    injector = get_injector()
+    results: list = [None] * len(descriptors)
+    by_peer: dict[tuple, list] = {}
+    for index, descriptor in enumerate(descriptors):
+        address = (descriptor["host"], int(descriptor["port"]))
+        by_peer.setdefault(address, []).append(index)
+    fetch_start = time.perf_counter()
+    for address, indices in by_peer.items():
+        backoff = transfer_retry_backoff()
+        attempt = 0
+        remaining = list(indices)
+        while remaining:
+            try:
+                if injector is not None and injector.fetch_drop():
+                    raise OSError("injected socket drop (fetch_drop)")
+                with socket.create_connection(
+                        address, timeout=timeout) as conn:
+                    conn.settimeout(timeout)
+                    # a batch alternates small key writes with reads:
+                    # Nagle + delayed ACK would cost ~40 ms per leaf
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    metrics.counter("transfer.batched_fetches").inc()
+                    while remaining:
+                        index = remaining[0]
+                        descriptor = descriptors[index]
+                        conn.sendall(
+                            descriptor["key"].encode("ascii") + b"\n")
+                        header = _recv_exact(conn, _HEADER.size)
+                        (length,) = _HEADER.unpack(header)
+                        if length == 0:
+                            metrics.counter(
+                                "transfer.fetch_expired").inc()
+                            raise KeyError(
+                                f"tensor {descriptor['key']} expired "
+                                f"at {address[0]}:{address[1]}")
+                        raw = _recv_exact(conn, length)
+                        array = np.frombuffer(
+                            raw, dtype=_resolve_dtype(
+                                descriptor["dtype"]))
+                        results[index] = array.reshape(
+                            descriptor["shape"])
+                        metrics.counter("transfer.fetches").inc()
+                        metrics.counter(
+                            "transfer.fetched_bytes").inc(length)
+                        remaining.pop(0)
+            except OSError as error:
+                metrics.counter("transfer.fetch_errors").inc()
+                if attempt >= retries:
+                    raise TransferError(
+                        f"batched tensor fetch from "
+                        f"{address[0]}:{address[1]} failed after "
+                        f"{attempt + 1} attempts with "
+                        f"{len(remaining)} leaves left: "
+                        f"{error}") from error
+                metrics.counter("transfer.fetch_retries").inc()
+                time.sleep(backoff * (2.0 ** attempt))
+                attempt += 1
+    metrics.histogram("transfer.fetch_s").record(
+        time.perf_counter() - fetch_start)
+    return results
 
 
 def _recv_exact(conn: socket.socket, count: int) -> bytearray:

@@ -8,12 +8,18 @@
 # lifecycle layer with warm-start replicas (persistent compile cache +
 # live sibling weight hand-off), and crash consistency (journal.py): a
 # write-ahead journal of routing state plus hot-standby election so a
-# gateway crash re-pins every stream exactly-once.  See README
-# "Serving gateway", "Elastic scaling", and "Crash recovery".
+# gateway crash re-pins every stream exactly-once, and prefill/decode
+# disaggregation (disagg.py): the gateway splits the pool by replica
+# role, routes prompts through a prefill pool, and forwards the KV
+# handoff to the stream's pinned decode replica.  See README "Serving
+# gateway", "Elastic scaling", "Crash recovery", and "Disaggregated
+# serving".
 
 from .policy import AdmissionPolicy, TokenBucket          # noqa: F401
 from .journal import (                                    # noqa: F401
     GatewayJournal, JournalPolicy)
+from .disagg import (                                     # noqa: F401
+    DISAGG_GRAMMAR, DisaggPolicy)
 from .gateway import Gateway, SERVICE_PROTOCOL_GATEWAY    # noqa: F401
 from .autoscale import (                                  # noqa: F401
     AutoScaler, InProcessReplicaFactory, ProcessReplicaFactory,

@@ -180,8 +180,26 @@ class AutoScaler:
         self._below_low_since: float | None = None
         self._sequence = 0
         self._stopped = False
+        # disaggregated fleets (gateway `disagg` policy + a factory
+        # DICT {role: factory}): the two pools scale INDEPENDENTLY --
+        # prefill on queue pressure, decode on slot occupancy -- each
+        # with its own watermark state and per-pool floor
+        self.disagg = getattr(gateway, "disagg", None)
+        self._pool_state = {
+            role: {"last_scale": 0.0, "below_low_since": None}
+            for role in ("prefill", "decode")}
+        self._pending_roles = {"prefill": 0, "decode": 0}
+        self._handle_roles: dict = {}     # topic_path -> pool role
+        self._last_prefill_fallbacks = 0
         gateway.process.event.add_timer_handler(
             self._tick, self.policy.interval_s)
+
+    def _factory_for(self, role: str | None):
+        if isinstance(self.factory, dict):
+            return self.factory.get(role or "decode")
+        # a single factory serves the one-pool (non-disagg) fleet and
+        # the decode pool; it cannot spawn prefill replicas
+        return self.factory if role in (None, "decode") else None
 
     # -- the control loop --------------------------------------------------
 
@@ -199,14 +217,44 @@ class AutoScaler:
             return None if demand == 0 else float("inf")
         return demand / capacity
 
-    def _live(self) -> list:
+    def pool_utilization(self, role: str) -> float | None:
+        """One disagg pool's scale signal.  The DECODE pool reads slot
+        occupancy (routed frames + the parked queue over capacity),
+        like the one-pool fleet.  The PREFILL pool reads QUEUE
+        pressure: frames in flight at prefill replicas, frames queued
+        inside them, and frames that fell back to local prefill since
+        the last tick (demand the pool was too small to even see) --
+        prefill work is one bounded kernel per frame, so waiting, not
+        occupancy, is what blows TTFT."""
+        live = self._live(role)
+        if role == "prefill":
+            fallbacks = self.gateway.telemetry.prefill_fallbacks.value
+            delta = max(0, fallbacks - self._last_prefill_fallbacks)
+            self._last_prefill_fallbacks = fallbacks
+            demand = sum(replica.outstanding
+                         + replica.reported_queue_depth()
+                         for replica in live) + delta
+        else:
+            demand = (sum(replica.outstanding for replica in live)
+                      + len(self.gateway._parked))
+        capacity = len(live) * self.gateway.policy.max_inflight
+        if capacity <= 0:
+            return None if demand == 0 else float("inf")
+        return demand / capacity
+
+    def _live(self, role: str | None = None) -> list:
         return [replica for replica in self.gateway.replicas.values()
-                if not replica.dead and not replica.draining]
+                if not replica.dead and not replica.draining
+                and (role is None or replica.pool_role() == role)]
 
     def _tick(self) -> None:
         if self._stopped:
             return
         now = time.monotonic()
+        if self.disagg is not None and isinstance(self.factory, dict):
+            for role in ("decode", "prefill"):
+                self._tick_pool(role, now)
+            return
         live = self._live()
         size = len(live) + self.pending
         can_spawn = self.factory is not None
@@ -240,14 +288,54 @@ class AutoScaler:
             self._scale_down(now, live)
             self._below_low_since = None
 
+    def _tick_pool(self, role: str, now: float) -> None:
+        """One disagg pool's watermark pass: the same scale-up-fast /
+        scale-down-slow state machine as the one-pool fleet, evaluated
+        against THIS pool's signal, floor, and cooldown."""
+        live = self._live(role)
+        state = self._pool_state[role]
+        pending = self._pending_roles[role]
+        size = len(live) + pending
+        floor = self.disagg.floor(role, self.policy.min_replicas)
+        can_spawn = self._factory_for(role) is not None
+        if size < floor and can_spawn:
+            self._scale_up(now, live, role=role)
+            return
+        utilization = self.pool_utilization(role)
+        if utilization is None:
+            return
+        in_cooldown = now - state["last_scale"] < self.policy.cooldown_s
+        if utilization > self.policy.low_water:
+            state["below_low_since"] = None
+        elif state["below_low_since"] is None:
+            state["below_low_since"] = now
+        if (utilization >= self.policy.high_water
+                and size < self.policy.max_replicas
+                and can_spawn
+                and pending == 0 and not in_cooldown):
+            self._scale_up(now, live, role=role)
+        elif (state["below_low_since"] is not None
+                and now - state["below_low_since"]
+                >= self.policy.cooldown_s
+                and len(live) > floor
+                and pending == 0 and not in_cooldown):
+            self._scale_down(now, live, role=role)
+            state["below_low_since"] = None
+
     # -- scale up ----------------------------------------------------------
 
-    def _scale_up(self, now: float, live: list) -> None:
+    def _scale_up(self, now: float, live: list,
+                  role: str | None = None) -> None:
         self._last_scale = now
+        if role is not None:
+            self._pool_state[role]["last_scale"] = now
         self._sequence += 1
-        name = f"{self.gateway.name}-r{self._sequence}"
+        pool_tag = f"-{role}" if role is not None else ""
+        name = f"{self.gateway.name}{pool_tag}-r{self._sequence}"
         warm_source = None
         if self.policy.warm_start:
+            # warm-start from a SAME-POOL sibling: a prefill replica's
+            # params are the right hand-off for a prefill spawn
             source = next((replica for replica in live
                            if replica.pipeline is not None), None)
             if source is not None:
@@ -258,9 +346,11 @@ class AutoScaler:
                 warm_source = source.pipeline
         warm = warm_source is not None
         self.pending += 1
+        if role is not None:
+            self._pending_roles[role] += 1
         self.gateway.telemetry.scale_ups.inc()
         record = self._pending_spawns[name] = {
-            "decided": now, "warm": warm}
+            "decided": now, "warm": warm, "role": role}
         if self.policy.spawn_timeout_s > 0:
             # a spawn that never becomes healthy (child crashed during
             # bring-up, bad definition) must not hold its pool slot
@@ -268,9 +358,10 @@ class AutoScaler:
             record["lease"] = Lease(
                 self.gateway.process.event, self.policy.spawn_timeout_s,
                 name, lease_expired_handler=self._spawn_expired)
-        _LOGGER.info("%s: scale UP -> spawning %s (%s)",
+        _LOGGER.info("%s: scale UP -> spawning %s (%s%s)",
                      self.gateway.name, name,
-                     "warm" if warm else "cold")
+                     "warm" if warm else "cold",
+                     f", pool {role}" if role is not None else "")
 
         def ready(handle, info=None):
             # factory thread -> gateway CONTROL mailbox (see
@@ -279,11 +370,10 @@ class AutoScaler:
                                       [handle, info or {"name": name}])
 
         try:
-            self.factory.spawn(name, warm_source=warm_source,
-                               ready=ready)
+            self._factory_for(role).spawn(name, warm_source=warm_source,
+                                          ready=ready)
         except Exception as error:
-            self._pending_spawns.pop(name, None)
-            self.pending = max(0, self.pending - 1)
+            self._close_pending(name)
             _LOGGER.exception("%s: spawn %s failed to launch: %s",
                               self.gateway.name, name, error)
 
@@ -297,6 +387,10 @@ class AutoScaler:
         if lease is not None:
             lease.terminate()
         self.pending = max(0, self.pending - 1)
+        role = record.get("role")
+        if role is not None:
+            self._pending_roles[role] = max(
+                0, self._pending_roles[role] - 1)
         return record
 
     def _spawn_expired(self, name) -> None:
@@ -325,7 +419,7 @@ class AutoScaler:
                             "off; retiring it", self.gateway.name, name)
             try:
                 if self.factory is not None:
-                    self.factory.retire(handle)
+                    self._retire_handle(handle)
             except Exception:
                 _LOGGER.exception("%s: late-spawn retire failed",
                                   self.gateway.name)
@@ -345,8 +439,10 @@ class AutoScaler:
                           ".pipeline; dropped", self.gateway.name, name)
             return
         self._handles[pipeline.topic_path] = handle
+        self._handle_roles[pipeline.topic_path] = record.get("role")
         self.gateway.attach_replica(
-            pipeline, warm=bool(record and record.get("warm")))
+            pipeline, warm=bool(record and record.get("warm")),
+            role=record.get("role"))
         if name in self._pending_spawns:
             # attach ran note_replica_added synchronously; the record
             # still pending means the pipeline's name does not match
@@ -369,6 +465,7 @@ class AutoScaler:
             # discovered (OS process) replica: the factory retires it
             # by NAME through the lifecycle layer
             self._handles[replica.topic_path] = replica.name
+            self._handle_roles[replica.topic_path] = record.get("role")
         elapsed_ms = (time.monotonic() - record["decided"]) * 1000.0
         self.gateway.telemetry.record_spawn(elapsed_ms, replica.warm)
         entry = {"name": replica.name, "warm": replica.warm,
@@ -381,9 +478,22 @@ class AutoScaler:
                      self.gateway.name, replica.name, elapsed_ms,
                      "warm" if replica.warm else "cold")
 
+    def _retire_handle(self, handle, role: str | None = None) -> None:
+        """Retire a handle through the owning factory; with a factory
+        dict and no known role, every factory is offered the handle
+        (retire is a tolerant no-op on a handle it never spawned)."""
+        factory = self._factory_for(role)
+        if factory is not None:
+            factory.retire(handle)
+            return
+        if isinstance(self.factory, dict):
+            for candidate in self.factory.values():
+                candidate.retire(handle)
+
     # -- scale down --------------------------------------------------------
 
-    def _scale_down(self, now: float, live: list) -> None:
+    def _scale_down(self, now: float, live: list,
+                    role: str | None = None) -> None:
         if self.factory is not None:
             # only retire replicas this controller OWNS: draining a
             # discovered/manually-attached replica would leave its
@@ -402,6 +512,8 @@ class AutoScaler:
                                           len(replica.streams),
                                           replica.topic_path))
         self._last_scale = now
+        if role is not None:
+            self._pool_state[role]["last_scale"] = now
         replica = self.gateway.drain_replica(victim.topic_path,
                                              "low watermark")
         if replica is None:
@@ -434,7 +546,8 @@ class AutoScaler:
         if lease is not None and lease in self._retiring:
             self._retiring.remove(lease)  # fired: stop tracking it
         try:
-            self.factory.retire(handle)
+            self._retire_handle(handle,
+                                self._handle_roles.pop(topic_path, None))
         except Exception:
             _LOGGER.exception("%s: replica retire failed",
                               self.gateway.name)
@@ -458,13 +571,15 @@ class AutoScaler:
         # factory-owned LIVE replicas die with their controller too: a
         # stopped gateway must not strand the fleet it spawned
         if self.factory is not None:
-            for handle in list(self._handles.values()):
+            for topic_path, handle in list(self._handles.items()):
                 try:
-                    self.factory.retire(handle)
+                    self._retire_handle(
+                        handle, self._handle_roles.get(topic_path))
                 except Exception:
                     _LOGGER.exception("%s: replica retire failed",
                                       self.gateway.name)
         self._handles.clear()
+        self._handle_roles.clear()
 
 
 class _SpawnHandle:

@@ -99,10 +99,11 @@ class _LocalResponder:
 class _Replica:
     __slots__ = ("topic_path", "name", "pipeline", "consumer", "cache",
                  "outstanding", "streams", "dead", "saturated",
-                 "below_since", "routed", "draining", "warm")
+                 "below_since", "routed", "draining", "warm", "role")
 
     def __init__(self, topic_path: str, name: str, pipeline=None,
-                 consumer=None, cache=None, warm: bool = False):
+                 consumer=None, cache=None, warm: bool = False,
+                 role: str = "decode"):
         self.topic_path = topic_path
         self.name = name
         self.pipeline = pipeline      # local direct attach (else None)
@@ -113,9 +114,19 @@ class _Replica:
         self.dead = False
         self.draining = False         # scale-down: no NEW placements
         self.warm = warm              # warm-started (hand-off + cache)
+        self.role = role              # disagg pool: prefill | decode
         self.saturated = False
         self.below_since: float | None = None
         self.routed = 0
+
+    def pool_role(self) -> str:
+        """Which disagg pool this replica serves: the attach-time role
+        for local replicas; for discovered ones the EC share's `role`
+        key (published by prefill-pool pipelines), so pool membership
+        rides the ordinary discovery plane."""
+        if self.pipeline is not None or self.consumer is None:
+            return self.role
+        return str(self.cache.get("role") or self.role)
 
     def reported_inflight(self) -> int:
         """The replica's OWN load claim: live for local replicas, the
@@ -179,7 +190,7 @@ class _GatewayStream:
                  "grace_time", "replica", "queue_response",
                  "topic_response", "throttle", "inflight", "delivered",
                  "delivered_floor", "cursor", "parked", "throttled",
-                 "lease")
+                 "lease", "prefill_created")
 
     def __init__(self, stream_id: str, priority: int, slo_ms: float,
                  parameters: dict, grace_time: float, replica: _Replica,
@@ -206,6 +217,9 @@ class _GatewayStream:
         self.parked = 0               # this stream's parked-queue entries
         self.throttled = False
         self.lease: Lease | None = None
+        # prefill replicas that already hold this stream (disagg hop 1
+        # creates lazily on first dispatch to each prefill replica)
+        self.prefill_created: set[str] = set()
 
     def is_delivered(self, frame_id: int) -> bool:
         return (frame_id <= self.delivered_floor
@@ -216,7 +230,8 @@ class Gateway(Actor):
     def __init__(self, process, name: str = "gateway", policy=None,
                  router_seed: int = 0, faults=None, telemetry: bool = True,
                  metrics_interval: float = 10.0, autoscale=None,
-                 replica_factory=None, journal=None, ha=None):
+                 replica_factory=None, journal=None, ha=None,
+                 disagg=None):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
         # construction-time validation through the shared
         # directive-grammar core (analyze/grammar.py): a typo'd policy
@@ -229,6 +244,26 @@ class Gateway(Actor):
                     else "AIKO403")
             raise ValueError(
                 f"{code}: gateway admission policy rejected: "
+                f"{error}") from None
+        # prefill/decode disaggregation (serve/disagg.py): with a
+        # disagg policy set, streams pin to the DECODE pool and every
+        # dispatchable frame takes a prefill hop through the
+        # least-loaded prefill replica first; the handoff rides the
+        # frame data to the pinned decode replica, which adopts the
+        # prompt's KV blocks instead of re-prefilling
+        try:
+            from .disagg import DisaggPolicy
+            self.disagg = (DisaggPolicy.parse(disagg)
+                           if disagg is not None else None)
+            if self.disagg is not None and self.disagg.role is not None:
+                raise ValueError(
+                    "a gateway disagg spec must not pin role= (the "
+                    "gateway fronts both pools)")
+        except ValueError as error:
+            code = ("AIKO404" if getattr(error, "kind", "") == "unknown"
+                    else "AIKO408")
+            raise ValueError(
+                f"{code}: gateway disagg policy rejected: "
                 f"{error}") from None
         self.replicas: dict[str, _Replica] = {}
         self.streams: dict[str, _GatewayStream] = {}
@@ -324,13 +359,19 @@ class Gateway(Actor):
 
     # -- replica pool ------------------------------------------------------
 
-    def attach_replica(self, pipeline, warm: bool = False) -> None:
+    def attach_replica(self, pipeline, warm: bool = False,
+                       role: str | None = None) -> None:
         """Wire an in-process Pipeline as a replica (the bench/test fast
         path: frame data and responses hand off as live objects).
         `warm` marks a warm-started replica (sibling weight hand-off +
-        persistent compile cache) for the pool telemetry."""
+        persistent compile cache) for the pool telemetry; `role` pins
+        the disagg pool (defaults to the pipeline's own `role` share
+        key -- set by a `disagg: "role=prefill"` definition parameter
+        -- else the decode pool)."""
+        if role is None:
+            role = str(pipeline.share.get("role") or "decode")
         replica = _Replica(pipeline.topic_path, pipeline.name,
-                          pipeline=pipeline, warm=warm)
+                          pipeline=pipeline, warm=warm, role=role)
         self._add_replica(replica)
 
     # -- elastic fleet (serve/autoscale.py drives these) -------------------
@@ -721,6 +762,7 @@ class Gateway(Actor):
                         "streams", self.name, replica.name, reason,
                         len(replica.streams))
         self._migrate_streams(replica)
+        self._recover_prefill_frames(replica.topic_path)
         self._update_share()
         # frames that parked while the replica was dying (dispatch saw
         # replica.dead before this cleanup ran) have no response left to
@@ -746,6 +788,8 @@ class Gateway(Actor):
                      "streams", self.name, replica.name, reason,
                      len(replica.streams))
         self._migrate_streams(replica)
+        self._recover_prefill_frames(replica.topic_path,
+                                     redispatch=False)
         self._update_share()
         self._drain_parked()
         return replica
@@ -800,6 +844,11 @@ class Gateway(Actor):
                 if frame_id in parked_ids:
                     continue
                 entry = stream.inflight[frame_id]
+                if len(entry) > 3:
+                    # mid-prefill-hop on a LIVE prefill replica: its
+                    # response re-dispatches through _prefill_done to
+                    # the NEW pin -- replaying here would double-send
+                    continue
                 if (target.has_capacity(self.policy)
                         and stream.parked == 0):
                     self._send_frame(target, stream, frame_id, entry)
@@ -809,11 +858,13 @@ class Gateway(Actor):
     # -- placement ---------------------------------------------------------
 
     def _place(self, now: float) -> _Replica | None:
-        """Power-of-two-choices over the placeable pool: sample two,
-        route to the lower load score.  Deterministic under the
-        `router_seed` RNG."""
+        """Power-of-two-choices over the placeable DECODE pool: sample
+        two, route to the lower load score.  Deterministic under the
+        `router_seed` RNG.  Streams only ever pin to decode-role
+        replicas -- a prefill replica holds no slot state to pin to."""
         candidates = [replica for replica in self.replicas.values()
-                      if replica.placeable(now, self.policy)]
+                      if replica.placeable(now, self.policy)
+                      and replica.pool_role() != "prefill"]
         if not candidates:
             return None
         if len(candidates) == 1:
@@ -821,12 +872,27 @@ class Gateway(Actor):
         first, second = self._rng.sample(candidates, 2)
         return first if first.score() <= second.score() else second
 
-    def _any_replica(self) -> _Replica | None:
-        """Least-loaded LIVE replica ignoring saturation/staleness:
-        the failover fallback (availability beats load hygiene when the
-        alternative is destroying a stream)."""
+    def _place_prefill(self, now: float) -> _Replica | None:
+        """Least-loaded prefill replica with dispatch capacity, or None
+        (pool empty/saturated -- the frame goes straight to its decode
+        replica and prefills locally; disaggregation degrades to
+        colocation, never to a stall)."""
         candidates = [replica for replica in self.replicas.values()
-                      if not replica.dead]
+                      if replica.pool_role() == "prefill"
+                      and not replica.dead and not replica.draining
+                      and replica.fresh(now, self.policy.stale_after_s)
+                      and replica.has_capacity(self.policy)]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda replica: replica.score())
+
+    def _any_replica(self) -> _Replica | None:
+        """Least-loaded LIVE decode replica ignoring saturation/
+        staleness: the failover fallback (availability beats load
+        hygiene when the alternative is destroying a stream)."""
+        candidates = [replica for replica in self.replicas.values()
+                      if not replica.dead
+                      and replica.pool_role() != "prefill"]
         if not candidates:
             return None
         return min(candidates, key=lambda replica: replica.score())
@@ -889,6 +955,12 @@ class Gateway(Actor):
             # dead-letter, which frees the gateway slot (see
             # _dead_letter_handler) -- no second deadline layer here
             parameters["frame_deadline"] = self.policy.frame_deadline_s
+        if self.disagg is not None and "adopt_timeout" not in parameters:
+            # the disagg policy's fetch bound reaches the DECODE
+            # replica as a stream parameter (same mechanism as
+            # frame_deadline): LMGenerate reads it per stream, so one
+            # gateway knob governs the whole fleet's adopt fallback
+            parameters["adopt_timeout"] = self.disagg.adopt_timeout_s
         stream = _GatewayStream(
             stream_id, priority, slo_ms, parameters, grace_time, replica,
             queue_response=queue_response, topic_response=topic_response,
@@ -973,8 +1045,27 @@ class Gateway(Actor):
         stream.inflight[frame_id] = entry
         self._mark_journal(stream)
         replica = stream.replica
-        if (replica is not None and replica.has_capacity(self.policy)
-                and stream.parked == 0):
+        dispatchable = (replica is not None
+                        and replica.has_capacity(self.policy)
+                        and stream.parked == 0)
+        if dispatchable and self.disagg is not None:
+            # disaggregated hop 1: the least-loaded prefill replica
+            # computes the prompt and returns a KV handoff; hop 2
+            # (_prefill_done) forwards it to the pinned decode replica.
+            # No prefill capacity -> straight to decode (local prefill)
+            prefill = self._place_prefill(time.monotonic())
+            if prefill is not None:
+                if prefill.topic_path not in stream.prefill_created:
+                    # the stream pins to its DECODE replica; a prefill
+                    # replica only needs enough stream state to run
+                    # prompt frames, created on first use
+                    stream.prefill_created.add(prefill.topic_path)
+                    self._send_create(prefill, stream)
+                entry.append(("prefill", prefill.topic_path))
+                self.telemetry.prefill_routed.inc()
+                self._send_frame(prefill, stream, frame_id, entry)
+                return
+        if dispatchable:
             self._send_frame(replica, stream, frame_id, entry)
         else:
             self._park(stream, frame_id, seq)
@@ -995,16 +1086,31 @@ class Gateway(Actor):
             stream.parked = 0
             self._note_queue_depth()
         replica = stream.replica
+        # frames mid-prefill-hop hold a PREFILL replica's slot, not the
+        # pinned decode replica's -- release each where it was sent
+        staged = 0
+        for frame_id, entry in stream.inflight.items():
+            if frame_id in parked_ids or len(entry) <= 3:
+                continue
+            staged += 1
+            prefill = self.replicas.get(entry[3][1])
+            if prefill is not None:
+                prefill.outstanding = max(0, prefill.outstanding - 1)
+                prefill.note_load(time.monotonic(), self.policy)
         if replica is not None:
             replica.streams.discard(stream_id)
             # only DISPATCHED frames hold replica slots: parked entries
             # never incremented outstanding
             replica.outstanding = max(
-                0, replica.outstanding - sum(
+                0, replica.outstanding - (sum(
                     1 for frame_id in stream.inflight
-                    if frame_id not in parked_ids))
+                    if frame_id not in parked_ids) - staged))
             replica.note_load(time.monotonic(), self.policy)
             self._send_destroy(replica, stream_id)
+        for topic_path in stream.prefill_created:
+            prefill = self.replicas.get(topic_path)
+            if prefill is not None:
+                self._send_destroy(prefill, stream_id)
         stream.inflight.clear()
         self._journal_forget(stream_id)
         self._update_share()
@@ -1041,10 +1147,13 @@ class Gateway(Actor):
                 generate("destroy_stream", [stream_id]))
 
     def _send_frame(self, replica: _Replica, stream: _GatewayStream,
-                    frame_id: int, entry: list) -> None:
+                    frame_id: int, entry: list, data=None) -> None:
         """Route one frame to `replica`, consulting the seeded
         `replica_kill` fault point first (one consult per ROUTED frame:
-        frame=k kills the replica on its k-th routed frame)."""
+        frame=k kills the replica on its k-th routed frame).  `data`
+        overrides the wire payload (the disagg decode hop sends the
+        original frame data MERGED with the prefill handoff; entry[0]
+        stays the original so failover replay restarts from scratch)."""
         if (self.faults is not None and not replica.dead
                 and self.faults.replica_kill(replica.name)):
             _LOGGER.warning(
@@ -1065,16 +1174,17 @@ class Gateway(Actor):
         replica.note_load(time.monotonic(), self.policy)
         self.telemetry.routed.inc()
         self.telemetry.record_replica_routed(replica.name)
+        payload = entry[0] if data is None else data
         if replica.pipeline is not None:
             replica.pipeline.post_message("process_frame", [
                 {"stream_id": stream.stream_id, "frame_id": frame_id},
-                entry[0]])
+                payload])
         else:
             self.process.publish(
                 f"{replica.topic_path}/in",
                 generate("process_frame", [
                     {"stream_id": stream.stream_id, "frame_id": frame_id},
-                    encode_frame_data(entry[0]).encode("ascii")]))
+                    encode_frame_data(payload).encode("ascii")]))
 
     # -- parked queue / backpressure ---------------------------------------
 
@@ -1272,6 +1382,13 @@ class Gateway(Actor):
 
     def _frame_done(self, stream: _GatewayStream, frame_id: int,
                     outputs: dict, event=None) -> None:
+        staged = stream.inflight.get(frame_id)
+        if (staged is not None and len(staged) > 3
+                and not stream.is_delivered(frame_id)):
+            # disaggregated hop 1 answered: forward to the decode pool
+            # instead of completing the frame
+            self._prefill_done(stream, frame_id, staged, outputs, event)
+            return
         entry = stream.inflight.pop(frame_id, None)
         if entry is None or stream.is_delivered(frame_id):
             self.telemetry.duplicates.inc()
@@ -1324,6 +1441,73 @@ class Gateway(Actor):
                         reply,
                         encode_frame_data(outputs).encode("ascii")]))
         self._drain_parked()
+
+    def _prefill_done(self, stream: _GatewayStream, frame_id: int,
+                      entry: list, outputs, event=None) -> None:
+        """Hop 2 of the disaggregated path: the prefill replica
+        answered -- release its slot and forward the frame to the
+        pinned decode replica with the KV handoff merged into the
+        payload.  A prefill error/drop (or a response without a
+        handoff) degrades to the direct dispatch: the decode replica
+        prefills locally, the stream never notices."""
+        stage_topic = entry[3][1]
+        del entry[3:]               # back to the plain replay shape
+        prefill = self.replicas.get(stage_topic)
+        if prefill is not None:
+            prefill.outstanding = max(0, prefill.outstanding - 1)
+            prefill.note_load(time.monotonic(), self.policy)
+        handoff = None
+        if not event and isinstance(outputs, dict):
+            handoff = outputs.get("handoff")
+        if handoff is not None:
+            self.telemetry.kv_migrations.inc()
+        else:
+            self.telemetry.prefill_fallbacks.inc()
+        replica = stream.replica
+        if (replica is not None and replica.has_capacity(self.policy)
+                and stream.parked == 0):
+            data = entry[0]
+            if handoff is not None:
+                data = dict(entry[0])
+                data["handoff"] = handoff
+            self._send_frame(replica, stream, frame_id, entry,
+                             data=data)
+        else:
+            # parks replay the ORIGINAL frame data when they drain (the
+            # handoff's transfer keys may expire while parked); the
+            # decode replica prefills locally -- degraded, never lost
+            self._park(stream, frame_id, entry[2])
+
+    def _recover_prefill_frames(self, topic_path: str,
+                                redispatch: bool = True) -> None:
+        """A prefill replica left the pool with frames mid-hop: those
+        frames belong to streams pinned to DECODE replicas, so stream
+        migration never sees them.  On replica DEATH (redispatch=True)
+        each is sent directly to its pinned decode replica (local
+        re-prefill) -- the disagg analogue of failover replay, zero
+        frames lost.  On a graceful DRAIN the frames are left in
+        flight: the draining replica keeps serving through its linger
+        window and its handoff responses forward normally; a
+        re-dispatch here would race them -- the stale prefill response
+        would arrive against a de-staged entry and be DELIVERED to the
+        client as the frame's final output."""
+        for stream in self.streams.values():
+            # a restarted prefill process must get a fresh create
+            stream.prefill_created.discard(topic_path)
+            if not redispatch:
+                continue
+            for frame_id, entry in list(stream.inflight.items()):
+                if len(entry) <= 3 or entry[3][1] != topic_path:
+                    continue
+                del entry[3:]
+                self.telemetry.prefill_fallbacks.inc()
+                replica = stream.replica
+                if (replica is not None
+                        and replica.has_capacity(self.policy)
+                        and stream.parked == 0):
+                    self._send_frame(replica, stream, frame_id, entry)
+                else:
+                    self._park(stream, frame_id, entry[2])
 
     def _completion_rate(self) -> float | None:
         """Completions/sec over the recent window (None until warm):
@@ -1383,6 +1567,7 @@ class Gateway(Actor):
                 "queue_depth": replica.reported_queue_depth(),
                 "streams": len(replica.streams),
                 "warm": replica.warm,
+                "role": replica.pool_role(),
             }
         return pool
 

@@ -49,6 +49,8 @@ class ElementProfile:
     engine_queue_s: list = field(default_factory=list)
     engine_prefill_s: list = field(default_factory=list)
     engine_decode_s: list = field(default_factory=list)
+    # disaggregated adoption: KV-migration fetch + pool scatter spans
+    engine_adopt_s: list = field(default_factory=list)
     engine_preemptions: int = 0
     engine_tokens: int = 0
 
@@ -58,7 +60,8 @@ class ElementProfile:
 
     @property
     def is_engine_managed(self) -> bool:
-        return bool(self.engine_prefill_s or self.engine_decode_s)
+        return bool(self.engine_prefill_s or self.engine_decode_s
+                    or self.engine_adopt_s)
 
 
 @dataclass
@@ -276,6 +279,11 @@ def _ingest_events(loaded: LoadedTrace, events: list,
             span = float(dur) / 1e6
             if name.startswith("prefill:"):
                 profile.engine_prefill_s.append(span)
+            elif name.startswith("adopt:"):
+                # disaggregated serving: the decode replica's KV
+                # migration (batched transfer-plane fetch + pool
+                # scatter) -- classified apart from slot-queue waits
+                profile.engine_adopt_s.append(span)
             elif name.startswith("decode_steps:"):
                 profile.engine_decode_s.append(span)
                 args = event.get("args") or {}

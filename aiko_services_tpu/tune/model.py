@@ -13,6 +13,12 @@
 #   compile-bound   compile events keep firing past warmup: the
 #                   element re-specializes (shape churn / cohort
 #                   splits) and wall time is dominated by compilation
+#   migration-bound a disaggregated decode element spends more wall
+#                   time adopting migrated KV blocks (transfer-plane
+#                   fetch + pool scatter) than computing or queueing:
+#                   the prefill pool is too remote/slow, not the
+#                   kernel -- fix the transfer path or colocate,
+#                   a bigger slot pool will not help
 #   queue-bound     median scheduler wait exceeds median compute: the
 #                   element starves behind coalescing or a saturated
 #                   slot pool, not its own kernel
@@ -146,6 +152,8 @@ class CostModel:
                         profile.engine_prefill_s),
                     "decode_median_s": _median(
                         profile.engine_decode_s),
+                    "adopt_median_s": _median(profile.engine_adopt_s),
+                    "adoptions": len(profile.engine_adopt_s),
                     "preemptions": profile.engine_preemptions,
                     "tokens": profile.engine_tokens,
                     "requests": len(profile.engine_decode_s),
@@ -205,9 +213,15 @@ def classify_elements(model: CostModel) -> None:
                          if cost.calls else 0.0)
         evidence["compile_ratio"] = round(compile_ratio, 4)
         engine_queue = (cost.engine or {}).get("queue_median_s", 0.0)
+        engine_adopt = (cost.engine or {}).get("adopt_median_s", 0.0)
         queue_wait = max(cost.queue_median_s, engine_queue)
         if cost.compiles and compile_ratio >= COMPILE_RATIO_BOUND:
             cost.floor = "compile-bound"
+        elif engine_adopt > max(cost.compute_median_s, queue_wait,
+                                floor_s):
+            # disaggregated adoption dominates: the KV migration, not
+            # the kernel or the slot queue, is the floor
+            cost.floor = "migration-bound"
         elif queue_wait > max(cost.compute_median_s, floor_s):
             cost.floor = "queue-bound"
         elif cost.per_call_median_s <= floor_s or (

@@ -224,6 +224,22 @@ def _engine_recommendations(name, cost, parameters, slo) -> list:
     block_size = int(parameters.get("kv_block_size", 16) or 16)
     compute = (engine.get("prefill_median_s", 0.0)
                + engine.get("decode_median_s", 0.0))
+    if cost.floor == "migration-bound":
+        # the KV migration, not the kernel or the slot pool, floors
+        # this element: more decode slots cannot help -- grow the
+        # PREFILL pool (or shorten the transfer path) so adoptions
+        # stop dominating, and skip the slot-wait heuristic below
+        # (it would prescribe slots for a wire problem)
+        recommendations.append(Recommendation(
+            "gateway", "disagg_min_replicas_prefill",
+            None, 2,
+            f"migration-bound at {name}: KV adoption (median "
+            f"{engine.get('adopt_median_s', 0.0) * 1e3:.1f} ms) "
+            "dominates compute and queue wait -- raise the prefill "
+            "pool floor (disagg `min_replicas:prefill=`) or move the "
+            "pools closer",
+            floor=cost.floor, evidence=cost.evidence))
+        return recommendations
     if engine.get("queue_median_s", 0.0) > max(compute, 1e-9):
         proposed = min(slots * 2, 64)
         if proposed > slots:

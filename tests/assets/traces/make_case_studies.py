@@ -310,6 +310,103 @@ def spec_decode():
     _write_report("tune_spec_decode.json", report)
 
 
+def disagg_adopt():
+    """Round-16 study: prefill/decode disaggregation's KV migration as
+    a CLASSIFIABLE floor (`aiko tune` distinguishes migration-bound
+    from queue-bound, tune/model.py).
+
+    Two decode-pool elements over one synthesized serving window, both
+    with the recorded 8.5 ms/step decode compute (BENCH_NOTES
+    llama32_1b batch 4):
+
+      lm_adopt   adopts CROSS-HOST handoffs: llama32_1b KV is 32 KiB
+                 per token (2 sides x 16 layers x 8 kv-heads x 64 dims
+                 x bf16), so a 2k-token prompt migrates 64 MiB -- at a
+                 10 GbE transfer plane that is ~52 ms per adoption,
+                 dominating both compute and slot-queue wait ->
+                 migration-bound (fix the wire or the pool placement,
+                 NOT decode_slots)
+      lm_queued  same compute but a saturated slot pool: 30 ms median
+                 slot wait -> queue-bound (raise decode_slots)
+
+    The report's value is the DISTINCTION: identical compute medians,
+    different dominant floors, different recommended knobs."""
+    decode_ms = 8.5          # BENCH_NOTES round 5/6 decode row
+    adopt_ms = 52.4          # 64 MiB / 10 GbE + scatter
+    slot_wait_ms = 30.0
+    light_wait_ms = 2.0
+    definition = {
+        "name": "case_disagg_adopt",
+        "graph": ["(lm_adopt (lm_queued))"],
+        "elements": [
+            _element("lm_adopt", ["handoff"], ["tokens"]),
+            _element("lm_queued", ["tokens"], ["generated"]),
+        ],
+    }
+    config = {
+        "source": ("BENCH_NOTES decode row (8.5 ms/step, llama32_1b "
+                   "batch 4); adopt = 64 MiB KV per 2k prompt over "
+                   "10 GbE"),
+        "model": "llama32_1b (1.24B params, int8-free KV sizing)",
+        "kv_bytes_per_token": 32 * 1024,
+        "prompt_tokens": 2048,
+        "adopt_ms": adopt_ms,
+        "decode_step_ms": decode_ms,
+        "peak_tflops_assumed": PEAK_TFLOPS,
+    }
+    events = [{"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+               "args": {"name": "pipeline:case_study"}}]
+    ts = 0.0
+    for frame_id in range(16):
+        frame_start = ts
+        args = {"trace_id": f"1-{frame_id + 1:x}",
+                "frame_id": frame_id}
+        # lm_adopt: a light slot wait, the MIGRATION, then compute
+        events.append({"ph": "X", "name": "queue:lm_adopt",
+                       "cat": "queue", "ts": round(ts, 3),
+                       "dur": light_wait_ms * 1000.0, "pid": 1,
+                       "tid": 1, "args": dict(args)})
+        ts += light_wait_ms * 1000.0
+        events.append({"ph": "X", "name": "adopt:lm_adopt",
+                       "cat": "engine", "ts": round(ts, 3),
+                       "dur": adopt_ms * 1000.0, "pid": 1, "tid": 1,
+                       "args": dict(args)})
+        ts += adopt_ms * 1000.0
+        events.append({"ph": "X", "name": "lm_adopt",
+                       "cat": "element", "ts": round(ts, 3),
+                       "dur": decode_ms * 1000.0, "pid": 1, "tid": 1,
+                       "args": {**args, "path": "inline", "group": 1}})
+        ts += decode_ms * 1000.0
+        # lm_queued: the same compute behind a saturated slot pool
+        events.append({"ph": "X", "name": "queue:lm_queued",
+                       "cat": "queue", "ts": round(ts, 3),
+                       "dur": slot_wait_ms * 1000.0, "pid": 1,
+                       "tid": 1, "args": dict(args)})
+        ts += slot_wait_ms * 1000.0
+        events.append({"ph": "X", "name": "lm_queued",
+                       "cat": "element", "ts": round(ts, 3),
+                       "dur": decode_ms * 1000.0, "pid": 1, "tid": 1,
+                       "args": {**args, "path": "inline", "group": 1}})
+        ts += decode_ms * 1000.0
+        events.append({"ph": "X", "name": f"frame {frame_id}",
+                       "cat": "frame", "ts": round(frame_start, 3),
+                       "dur": round(ts - frame_start, 3), "pid": 1,
+                       "tid": 1,
+                       "args": {**args, "status": "ok",
+                                "stream": "bench"}})
+        ts += 100.0
+    path = os.path.join(HERE, "disagg_adopt.json")
+    _write(path, chrome_trace_document(events, metadata=trace_metadata(
+        definition_document=definition, config=config,
+        config_name="disagg")))
+    report = run_tune(path, slo_spec=SloSpec.parse("throughput"))
+    floors = {name: record["floor"]
+              for name, record in report["elements"].items()}
+    assert floors == {"lm_adopt": "migration-bound",
+                      "lm_queued": "queue-bound"}, floors
+    _write_report("tune_disagg_adopt.json", report)
+
+
 def _write_report(name, report):
     os.makedirs(REPORTS, exist_ok=True)
     path = os.path.join(REPORTS, name)
@@ -326,3 +423,4 @@ if __name__ == "__main__":
     train()
     chunked_prefill()
     spec_decode()
+    disagg_adopt()

@@ -227,10 +227,27 @@ class TestWeightHandoff:
             # the descriptor tree is wire-safe (the OS-process path
             # ships it through a JSON file)
             exported = json.loads(json.dumps(exported))
+            from aiko_services_tpu.observe.metrics import get_registry
+            registry = get_registry()
+            connections_before = registry.counter(
+                "transfer.connections").value
+            batched_before = registry.counter(
+                "transfer.batched_fetches").value
             installed = sibling.import_weights(exported)
             assert installed == ["affine"]
             handed_off = self._serve_one(sibling, 3.0)
             assert np.array_equal(handed_off, mutated)  # bit-identical
+            # the whole hand-off rode fetch_many: ONE connection per
+            # producing peer, not one TCP handshake per leaf
+            leaves = json.dumps(exported).count('"__tensorref__"')
+            assert leaves >= 2
+            connections = (registry.counter("transfer.connections").value
+                           - connections_before)
+            assert connections < leaves, (
+                f"{connections} connections for {leaves} leaves: the "
+                f"hand-off is not batching")
+            assert (registry.counter("transfer.batched_fetches").value
+                    > batched_before)
         finally:
             source_process.terminate()
             sibling_process.terminate()

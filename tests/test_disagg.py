@@ -1,0 +1,677 @@
+# Prefill/decode disaggregation (ISSUE 12): PrefillEngine KV export ->
+# batched transfer-plane fetch -> DecodeEngine.adopt_request, the
+# AIKO408 disagg grammar, the gateway's two-pool scheduling, and the
+# per-pool autoscaler signals.
+#
+# The acceptance invariant everywhere: tokens from the split fleet are
+# BIT-IDENTICAL to the co-located continuous engine (which the decode
+# suite pins to closed-batch generate()), and every failure mode --
+# expired handoff keys, a dead prefill replica, an exhausted adopting
+# pool -- degrades to a local re-prefill, never to a lost stream.
+
+import queue
+
+import numpy as np
+import pytest
+
+import jax
+
+from aiko_services_tpu.decode import (
+    DecodeEngine, PrefillEngine, fetch_kv_blocks)
+from aiko_services_tpu.models import (
+    TransformerConfig, generate, init_params)
+from aiko_services_tpu.observe.metrics import get_registry
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.pipeline.transfer import (
+    fetch_many, get_transfer_server, reset_transfer_server)
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.serve import DisaggPolicy, Gateway
+from aiko_services_tpu.transport import reset_brokers
+
+from helpers import wait_for
+
+ELEMENTS = "aiko_services_tpu.elements"
+
+TINY = dict(vocab_size=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_model=32, d_ff=64, max_seq_len=64, dtype="float32")
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    config = TransformerConfig(**TINY)
+    return init_params(config, jax.random.PRNGKey(0)), config
+
+
+def reference(params, config, prompt, max_new):
+    out, _ = generate(params, config, np.asarray(prompt)[None],
+                      max_new_tokens=max_new)
+    return np.asarray(out)[0]
+
+
+def run_split(params, config, prompts, max_new, *, adopt_timeout=5,
+              prefill_kwargs=None, decode_kwargs=None):
+    """Prefill every prompt on a PrefillEngine, adopt each handoff into
+    a DecodeEngine, and drain; returns (handoffs, completions, engines)."""
+    prefill = PrefillEngine(params, config, kv_block_size=8,
+                            **(prefill_kwargs or {}))
+    decode = DecodeEngine(params, config, decode_slots=len(prompts),
+                          kv_block_size=8, **(decode_kwargs or {}))
+    for index, prompt in enumerate(prompts):
+        prefill.submit(index, prompt, max_new)
+    handoffs = []
+    while prefill.has_work():
+        handoffs += prefill.step()
+    done = {}
+    for handoff in handoffs:
+        report = decode.adopt_request(handoff["request_id"], handoff,
+                                      timeout=adopt_timeout)
+        for completion in report.completions:
+            done[completion.request_id] = completion
+    steps = 0
+    while decode.has_work():
+        for completion in decode.step().completions:
+            done[completion.request_id] = completion
+        steps += 1
+        assert steps < 4000
+    return handoffs, done, (prefill, decode)
+
+
+# -- the round trip: export -> fetch -> adopt, bit-identical ----------------
+
+
+class TestAdoptRoundTrip:
+    PROMPT_LENGTHS = (5, 9, 12)
+
+    @pytest.mark.parametrize("kv_dtype", ("", "int8"))
+    def test_bit_identical_f32_and_int8(self, kv_dtype):
+        """The tentpole invariant: adopted decode continues the
+        migrated KV bit-identically to the co-located engine for both
+        the f32 and the int8 (codes + scales) pool layouts."""
+        config = TransformerConfig(**{**TINY, "kv_dtype": kv_dtype})
+        params = init_params(config, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, 64, size=n).astype(np.int32)
+                   for n in self.PROMPT_LENGTHS]
+        handoffs, done, (prefill, decode) = run_split(
+            params, config, prompts, 8)
+        assert len(done) == len(prompts)
+        for index, prompt in enumerate(prompts):
+            np.testing.assert_array_equal(
+                done[index].tokens,
+                reference(params, config, prompt, 8))
+        assert decode.counters["adopted"] == len(prompts)
+        assert decode.counters["adopt_fallbacks"] == 0
+        assert decode.counters["kv_migrated_bytes"] > 0
+        assert prefill.counters["exported"] == len(prompts)
+        # every block returned on BOTH sides
+        assert prefill.blocks.free_count == prefill.blocks.capacity
+        assert decode.stats()["free_blocks"] == decode.blocks.capacity
+
+    def test_chunked_prefill_export_matches(self, tiny_model):
+        """A prefill replica running paged_prefill_chunk exports the
+        same KV a monolithic prefill would: adopted output stays
+        bit-identical."""
+        params, config = tiny_model
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 64, size=n).astype(np.int32)
+                   for n in (21, 33)]
+        handoffs, done, (prefill, _) = run_split(
+            params, config, prompts, 6,
+            prefill_kwargs={"prefill_chunk_size": 8})
+        assert prefill.counters["chunks"] > 0
+        for index, prompt in enumerate(prompts):
+            np.testing.assert_array_equal(
+                done[index].tokens,
+                reference(params, config, prompt, 6))
+
+    def test_handoff_survives_the_frame_codec_inert(self, tiny_model):
+        """The wire-path regression: the frame codec must carry the
+        handoff's KV descriptors INERT (raw descriptor dicts, not
+        `__tensorref__` marker nodes the codec would eagerly
+        materialize leaf-by-leaf on the event loop) so the adopting
+        engine still does ONE batched fetch."""
+        from aiko_services_tpu.pipeline.tensors import (
+            decode_frame_data, encode_frame_data)
+        params, config = tiny_model
+        prompt = np.arange(1, 10, dtype=np.int32)
+        prefill = PrefillEngine(params, config, kv_block_size=8)
+        prefill.submit("r", prompt, 4)
+        [handoff] = prefill.step()
+        handoff = dict(handoff, request_id=0)
+        wire = decode_frame_data(encode_frame_data(
+            {"handoff": [handoff]}))
+        record = wire["handoff"][0]
+        assert isinstance(record["kv_blocks"][0]["k"], dict), (
+            "codec materialized the KV descriptors")
+        registry = get_registry()
+        batched_before = registry.counter(
+            "transfer.batched_fetches").value
+        decode = DecodeEngine(params, config, decode_slots=1,
+                              kv_block_size=8)
+        report = decode.adopt_request("r", record, timeout=5)
+        assert decode.counters["adopted"] == 1
+        assert (registry.counter("transfer.batched_fetches").value
+                == batched_before + 1)
+        done = {c.request_id: c for c in report.completions}
+        steps = 0
+        while decode.has_work():
+            for completion in decode.step().completions:
+                done[completion.request_id] = completion
+            steps += 1
+            assert steps < 2000
+        np.testing.assert_array_equal(
+            done["r"].tokens, reference(params, config, prompt, 4))
+
+    def test_handoff_is_json_safe(self, tiny_model):
+        """The handoff record must survive the frame codec: a JSON
+        round trip (what the wire path does) adopts identically."""
+        import json
+        params, config = tiny_model
+        prompt = np.arange(1, 10, dtype=np.int32)
+        prefill = PrefillEngine(params, config, kv_block_size=8)
+        prefill.submit("r", prompt, 4)
+        [handoff] = prefill.step()
+        handoff = json.loads(json.dumps(
+            {**handoff, "request_id": None}))
+        decode = DecodeEngine(params, config, decode_slots=1,
+                              kv_block_size=8)
+        report = decode.adopt_request("r", handoff, timeout=5)
+        done = {c.request_id: c for c in report.completions}
+        steps = 0
+        while decode.has_work():
+            for completion in decode.step().completions:
+                done[completion.request_id] = completion
+            steps += 1
+            assert steps < 2000
+        np.testing.assert_array_equal(
+            done["r"].tokens, reference(params, config, prompt, 4))
+        assert decode.counters["adopted"] == 1
+
+
+def test_adopt_mid_storm_zero_recompiles(tiny_model):
+    """A request adopted INTO A BUSY engine mid-storm: co-scheduled
+    slots keep decoding, outputs stay bit-identical, and the adoption
+    triggers ZERO engine recompiles (the pool scatter is not an engine
+    executable; the decode step shapes never change)."""
+    params, config = tiny_model
+    rng = np.random.default_rng(42)
+    engine = DecodeEngine(params, config, decode_slots=3,
+                          kv_block_size=8)
+    # warmup: every bucket + the decode step
+    for index, length in enumerate((3, 9, 17)):
+        engine.submit(("warm", index),
+                      np.arange(1, length + 1, dtype=np.int32), 3)
+    while engine.has_work():
+        engine.step()
+    prefill = PrefillEngine(params, config, kv_block_size=8)
+    warm_handoffs = []
+    prefill.submit("warm_adopt", np.arange(1, 6, dtype=np.int32), 2)
+    while prefill.has_work():
+        warm_handoffs += prefill.step()
+    engine.adopt_request("warm_adopt", warm_handoffs[0], timeout=5)
+    while engine.has_work():
+        engine.step()
+    warm = engine.compile_count
+
+    workload = {}
+    done = {}
+    adopted = 0
+    submitted = 0
+    while submitted < 12:
+        length = int(rng.integers(1, 21))
+        prompt = rng.integers(1, 64, size=length).astype(np.int32)
+        max_new = int(rng.integers(2, 8))
+        workload[submitted] = (prompt, max_new)
+        if submitted % 3 == 0:
+            # every third request arrives as a MIGRATION into the
+            # running storm
+            prefill.submit(submitted, prompt, max_new)
+            while prefill.has_work():
+                for handoff in prefill.step():
+                    report = engine.adopt_request(
+                        handoff["request_id"], handoff, timeout=5)
+                    adopted += 1
+                    for completion in report.completions:
+                        done[completion.request_id] = completion
+        else:
+            engine.submit(submitted, prompt, max_new)
+        submitted += 1
+        for _ in range(int(rng.integers(1, 4))):
+            for completion in engine.step().completions:
+                done[completion.request_id] = completion
+    steps = 0
+    while engine.has_work():
+        for completion in engine.step().completions:
+            done[completion.request_id] = completion
+        steps += 1
+        assert steps < 4000
+    assert adopted >= 3
+    assert engine.counters["adopted"] >= 3
+    for index, (prompt, max_new) in workload.items():
+        np.testing.assert_array_equal(
+            done[index].tokens,
+            reference(params, config, prompt, max_new))
+    assert engine.compile_count == warm, (
+        f"adoption storm recompiled {engine.compile_count - warm} "
+        f"signatures")
+
+
+def test_adopt_failure_falls_back_to_local_prefill(tiny_model):
+    """Expired transfer keys (the producer died / ttl lapsed) and a
+    dead producer port both fall back to a LOCAL re-prefill through
+    the ordinary admission path: the request still completes,
+    bit-identical, and the granted blocks are returned first."""
+    params, config = tiny_model
+    prompt = np.arange(1, 10, dtype=np.int32)
+    prefill = PrefillEngine(params, config, kv_block_size=8)
+    prefill.submit("r", prompt, 5)
+    [handoff] = prefill.step()
+    # consume every key so the adopt-side fetch sees expired entries
+    reset_transfer_server()
+    decode = DecodeEngine(params, config, decode_slots=1,
+                          kv_block_size=8)
+    free_before = decode.blocks.free_count
+    report = decode.adopt_request("r", handoff, timeout=1)
+    assert decode.counters["adopt_fallbacks"] == 1
+    assert decode.counters["adopted"] == 0
+    assert decode.blocks.free_count == free_before  # grant returned
+    done = {c.request_id: c for c in report.completions}
+    steps = 0
+    while decode.has_work():
+        for completion in decode.step().completions:
+            done[completion.request_id] = completion
+        steps += 1
+        assert steps < 2000
+    np.testing.assert_array_equal(
+        done["r"].tokens, reference(params, config, prompt, 5))
+
+    # a block-size mismatch (mixed fleet) takes the same fallback
+    other = DecodeEngine(params, config, decode_slots=1,
+                         kv_block_size=16)
+    prefill.submit("r2", prompt, 4)
+    [handoff2] = prefill.step()
+    other.adopt_request("r2", handoff2, timeout=1)
+    assert other.counters["adopt_fallbacks"] == 1
+    done2 = {}
+    while other.has_work():
+        for completion in other.step().completions:
+            done2[completion.request_id] = completion
+    np.testing.assert_array_equal(
+        done2["r2"].tokens, reference(params, config, prompt, 4))
+
+
+# -- fetch_many: the batched transfer path ----------------------------------
+
+
+class TestFetchMany:
+    def test_one_connection_per_peer_and_input_order(self):
+        server = get_transfer_server()
+        registry = get_registry()
+        arrays = [np.full((64, 64), fill, np.float32)
+                  for fill in range(7)]
+        descriptors = [server.offer(array) for array in arrays]
+        connections_before = registry.counter(
+            "transfer.connections").value
+        fetched = fetch_many(descriptors)
+        connections = (registry.counter("transfer.connections").value
+                       - connections_before)
+        assert connections == 1, (
+            f"{connections} connections for 7 same-peer descriptors")
+        for array, result in zip(arrays, fetched):
+            np.testing.assert_array_equal(array, result)
+
+    def test_expired_key_raises_keyerror(self):
+        server = get_transfer_server()
+        good = server.offer(np.ones((64, 64), np.float32))
+        bad = dict(good, key="f" * 32)
+        with pytest.raises(KeyError):
+            fetch_many([good, bad])
+
+    def test_dead_peer_raises_transfer_error(self):
+        from aiko_services_tpu.pipeline.transfer import TransferError
+        descriptor = {"host": "127.0.0.1", "port": 1,
+                      "key": "a" * 32, "dtype": "float32",
+                      "shape": [2]}
+        with pytest.raises(TransferError):
+            fetch_many([descriptor], timeout=0.2, retries=0)
+
+
+# -- the AIKO408 grammar -----------------------------------------------------
+
+
+class TestDisaggGrammar:
+    def test_policy_parses(self):
+        policy = DisaggPolicy.parse(
+            "adopt_timeout=2;min_replicas:prefill=1;"
+            "min_replicas:decode=2")
+        assert policy.adopt_timeout_s == 2.0
+        assert policy.min_replicas == {"prefill": 1, "decode": 2}
+        assert policy.role is None
+        replica = DisaggPolicy.parse("role=prefill")
+        assert replica.role == "prefill"
+
+    def test_bad_specs_fail_like_lint(self):
+        from aiko_services_tpu.analyze.policies import (
+            check_decode_parameters, check_disagg_policy)
+        with pytest.raises(ValueError, match="one of"):
+            DisaggPolicy.parse("role=gpu")
+        with pytest.raises(ValueError, match="replica-side"):
+            DisaggPolicy.parse("role=prefill;adopt_timeout=2")
+        problems = check_disagg_policy("adopt_timeout=-1")
+        assert any(code == "AIKO408" for code, _ in problems)
+        problems = check_disagg_policy("min_replicas:gpu=1")
+        assert any(code == "AIKO404" for code, _ in problems)
+        # element-level cross-field rules
+        problems = check_decode_parameters({"role": "decode"})
+        assert any(code == "AIKO408" for code, _ in problems)
+        problems = check_decode_parameters(
+            {"role": "prefill", "continuous": True})
+        assert any(code == "AIKO408" for code, _ in problems)
+        problems = check_decode_parameters(
+            {"role": "prefill", "prefill_chunk_size": 16})
+        assert problems == []  # the prefill engine chunks, no engine
+        problems = check_decode_parameters(
+            {"adopt_timeout": 2.0, "continuous": True})
+        assert any(code == "AIKO408" for code, _ in problems)
+
+    def test_gateway_construction_matches_lint(self):
+        # same idiom as the AIKO403/406 construction tests: the
+        # half-constructed gateways are abandoned with the process
+        process = Process(transport_kind="loopback")
+        with pytest.raises(ValueError, match="AIKO408"):
+            Gateway(process, name="bad", disagg="adopt_timeout=-1")
+        with pytest.raises(ValueError, match="AIKO404"):
+            Gateway(process, name="bad2", disagg="warp=9")
+        with pytest.raises(ValueError, match="AIKO408"):
+            Gateway(process, name="bad3", disagg="role=prefill")
+
+
+# -- gateway two-pool scheduling --------------------------------------------
+
+
+LM_PARAMS = {"vocab_size": 300, "d_model": 32, "n_layers": 1,
+             "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+             "max_seq_len": 128, "dtype": "float32",
+             "max_new_tokens": 6}
+
+
+def lm_definition(name, extra, prefill=False):
+    if prefill:
+        ports = {"input": [{"name": "tokens"}],
+                 "output": [{"name": "handoff"}]}
+        pipe_params = {"disagg": "role=prefill"}
+    else:
+        ports = {"input": [{"name": "tokens"},
+                           {"name": "handoff", "optional": True}],
+                 "output": [{"name": "generated"}]}
+        pipe_params = {}
+    return {
+        "name": name,
+        "graph": ["(lm)"],
+        "parameters": pipe_params,
+        "elements": [
+            {"name": "lm", **ports,
+             "parameters": {**LM_PARAMS, **extra},
+             "deploy": {"local": {"module": ELEMENTS,
+                                  "class_name": "LMGenerate"}}},
+        ],
+    }
+
+
+def make_prefill_pipeline(process, name):
+    return create_pipeline(process, lm_definition(
+        name, {"role": "prefill", "kv_block_size": 8}, prefill=True))
+
+
+def make_decode_pipeline(process, name):
+    return create_pipeline(process, lm_definition(
+        name, {"role": "decode", "continuous": True, "decode_slots": 4,
+               "kv_block_size": 8, "adopt_timeout": 5}))
+
+
+def closed_batch_reference(frames):
+    process = Process(transport_kind="loopback")
+    pipeline = create_pipeline(process, lm_definition("ref", {}))
+    process.run(in_thread=True)
+    responses = queue.Queue()
+    stream = pipeline.create_stream("s", queue_response=responses,
+                                    grace_time=300)
+    for frame in frames:
+        pipeline.create_frame(stream, {"tokens": frame})
+    expected = [np.asarray(responses.get(timeout=120)[2]["generated"])
+                for _ in frames]
+    process.terminate()
+    reset_brokers()
+    return expected
+
+
+class TestGatewayDisagg:
+    def test_split_pools_bit_identical(self):
+        """The serving-tier composition: a disagg gateway fronting a
+        prefill pool and a decode pool serves the same completions as
+        the plain pipeline -- streams pin to decode, prompts route to
+        prefill, KV migrates over the transfer plane."""
+        rng = np.random.default_rng(9)
+        frames = [rng.integers(1, 300, size=(1, 6)).astype(np.int32)
+                  for _ in range(4)]
+        expected = closed_batch_reference(frames)
+
+        processes = []
+        prefill_process = Process(transport_kind="loopback")
+        processes.append(prefill_process)
+        prefill_pipe = make_prefill_pipeline(prefill_process, "pre0")
+        decode_process = Process(transport_kind="loopback")
+        processes.append(decode_process)
+        decode_pipe = make_decode_pipeline(decode_process, "dec0")
+        gateway_process = Process(transport_kind="loopback")
+        processes.append(gateway_process)
+        gateway = Gateway(gateway_process,
+                          policy="max_inflight=8;queue=32",
+                          disagg="adopt_timeout=5")
+        gateway.attach_replica(prefill_pipe)   # role from the share
+        gateway.attach_replica(decode_pipe)
+        roles = {replica.name: replica.pool_role()
+                 for replica in gateway.replicas.values()}
+        assert roles == {"pre0": "prefill", "dec0": "decode"}
+        for process in processes:
+            process.run(in_thread=True)
+        try:
+            responses = queue.Queue()
+            gateway.submit_stream("g1", {}, queue_response=responses)
+            for frame_id, frame in enumerate(frames):
+                gateway.submit_frame("g1", {"tokens": frame},
+                                     frame_id=frame_id)
+            got = {}
+            for _ in frames:
+                _, frame_id, outputs, status = responses.get(
+                    timeout=120)
+                assert status == "ok", (frame_id, outputs)
+                got[frame_id] = np.asarray(outputs["generated"])
+            for frame_id, reference_out in enumerate(expected):
+                np.testing.assert_array_equal(got[frame_id],
+                                              reference_out)
+            # the data plane really split: prompts prefilled on the
+            # prefill replica, KV migrated, decode adopted (slot-full
+            # arrivals legitimately fall back)
+            engine = decode_pipe.elements["lm"].engine_stats()
+            assert engine["adopted"] >= 1
+            assert engine["kv_migrated_bytes"] > 0
+            prefill = prefill_pipe.elements["lm"].prefill_stats()
+            assert prefill["exported"] == len(frames)
+            assert gateway.telemetry.prefill_routed.value == len(frames)
+            assert gateway.telemetry.kv_migrations.value == len(frames)
+            snapshot = gateway.pool_snapshot()
+            assert snapshot["pre0"]["role"] == "prefill"
+            assert snapshot["dec0"]["role"] == "decode"
+        finally:
+            for process in processes:
+                process.terminate()
+
+    def test_prefill_replica_death_degrades_not_loses(self):
+        """Killing the ONLY prefill replica mid-stream: in-flight and
+        later frames all complete through the decode replica's local
+        prefill -- bit-identical, zero lost frames."""
+        rng = np.random.default_rng(11)
+        frames = [rng.integers(1, 300, size=(1, 6)).astype(np.int32)
+                  for _ in range(4)]
+        expected = closed_batch_reference(frames)
+
+        processes = []
+        prefill_process = Process(transport_kind="loopback")
+        processes.append(prefill_process)
+        prefill_pipe = make_prefill_pipeline(prefill_process, "pre1")
+        decode_process = Process(transport_kind="loopback")
+        processes.append(decode_process)
+        decode_pipe = make_decode_pipeline(decode_process, "dec1")
+        gateway_process = Process(transport_kind="loopback")
+        processes.append(gateway_process)
+        gateway = Gateway(gateway_process,
+                          policy="max_inflight=8;queue=32",
+                          disagg="adopt_timeout=2")
+        gateway.attach_replica(prefill_pipe)
+        gateway.attach_replica(decode_pipe)
+        for process in processes:
+            process.run(in_thread=True)
+        try:
+            responses = queue.Queue()
+            gateway.submit_stream("g1", {}, queue_response=responses)
+            gateway.submit_frame("g1", {"tokens": frames[0]},
+                                 frame_id=0)
+            responses.get(timeout=120)
+            # kill the prefill pool, then keep submitting
+            gateway.post_message("_replica_lost", [
+                prefill_pipe.topic_path, "test kill"])
+            wait_for(lambda: prefill_pipe.topic_path
+                     not in gateway.replicas, timeout=30)
+            for frame_id, frame in enumerate(frames[1:], start=1):
+                gateway.submit_frame("g1", {"tokens": frame},
+                                     frame_id=frame_id)
+            got = {0: None}
+            for _ in frames[1:]:
+                _, frame_id, outputs, status = responses.get(
+                    timeout=120)
+                assert status == "ok", (frame_id, outputs)
+                got[frame_id] = np.asarray(outputs["generated"])
+            for frame_id in range(1, len(frames)):
+                np.testing.assert_array_equal(got[frame_id],
+                                              expected[frame_id])
+        finally:
+            for process in processes:
+                process.terminate()
+
+
+# -- per-pool autoscaling ----------------------------------------------------
+
+
+def test_autoscaler_scales_pools_on_their_own_signals():
+    """With a disagg gateway and a factory dict, the controller reads
+    each pool's OWN signal: prefill queue pressure spawns a prefill
+    replica without touching the decode pool, and per-pool floors are
+    repaired independently."""
+    from aiko_services_tpu.serve import AutoScaler
+
+    process = Process(transport_kind="loopback")
+    gateway = Gateway(process, policy="max_inflight=2;queue=64",
+                      disagg=("adopt_timeout=2;min_replicas:prefill=1;"
+                              "min_replicas:decode=1"))
+    process.run(in_thread=True)
+
+    spawned = []
+
+    class Factory:
+        def __init__(self, role):
+            self.role = role
+
+        def spawn(self, name, warm_source=None, ready=None):
+            spawned.append((self.role, name))
+            return None
+
+        def retire(self, handle):
+            pass
+
+    scaler = AutoScaler(
+        gateway, "min_replicas=1;max_replicas=3;cooldown=0.05;"
+        "interval=30;high_water=0.75",
+        {"prefill": Factory("prefill"), "decode": Factory("decode")})
+    gateway.autoscaler = scaler
+    try:
+        # empty fleet: BOTH pool floors repair, each through its own
+        # factory
+        scaler._tick()
+        assert ("decode", f"{gateway.name}-decode-r1") in spawned
+        scaler._tick()
+        assert any(role == "prefill" for role, _ in spawned)
+        assert scaler._pending_roles == {"prefill": 1, "decode": 1}
+        # fake both pools healthy
+        class Stub:
+            consumer = None
+            pipeline = None
+
+            def __init__(self, role, topic):
+                self.role_value, self.topic_path = role, topic
+                self.name = topic
+                self.outstanding = 0
+                self.dead = self.draining = False
+                self.streams = set()
+
+            def pool_role(self):
+                return self.role_value
+
+            def reported_queue_depth(self):
+                return 0
+
+        for record in list(scaler._pending_spawns):
+            scaler._close_pending(record)
+        decode_replica = Stub("decode", "t/decode")
+        prefill_replica = Stub("prefill", "t/prefill")
+        gateway.replicas["t/decode"] = decode_replica
+        gateway.replicas["t/prefill"] = prefill_replica
+        spawned.clear()
+        # prefill pressure only: fallbacks accumulated since the last
+        # tick read as unmet prefill demand; decode stays idle
+        gateway.telemetry.prefill_fallbacks.inc(8)
+        import time as time_module
+        time_module.sleep(0.06)     # clear both cooldowns
+        scaler._tick()
+        assert [role for role, _ in spawned] == ["prefill"], spawned
+        # decode pressure only: outstanding frames over capacity
+        for record in list(scaler._pending_spawns):
+            scaler._close_pending(record)
+        spawned.clear()
+        decode_replica.outstanding = 4
+        time_module.sleep(0.06)
+        scaler._tick()
+        assert [role for role, _ in spawned] == ["decode"], spawned
+    finally:
+        gateway.replicas.pop("t/decode", None)
+        gateway.replicas.pop("t/prefill", None)
+        scaler.stop()
+        gateway.autoscaler = None
+        process.terminate()
+
+
+def test_import_weights_batches_connections():
+    """Satellite: the warm-start hand-off path rides fetch_many -- one
+    connection for a whole multi-leaf export (see also the autoscale
+    suite's end-to-end assertion)."""
+    server = get_transfer_server()
+    registry = get_registry()
+    from aiko_services_tpu.pipeline.transfer import TENSOR_REF_KEY
+    leaves = {f"leaf{i}": np.full((32, 32), i, np.float32)
+              for i in range(6)}
+    tree = {name: {TENSOR_REF_KEY: server.offer(array)}
+            for name, array in leaves.items()}
+    descriptors = [node[TENSOR_REF_KEY] for node in tree.values()]
+    before = registry.counter("transfer.connections").value
+    fetched = fetch_many(descriptors)
+    assert (registry.counter("transfer.connections").value
+            - before) == 1
+    for (name, array), result in zip(leaves.items(), fetched):
+        np.testing.assert_array_equal(array, result)
