@@ -68,6 +68,8 @@ RULES = {
     "AIKO406": ("error", "invalid autoscale policy spec"),
     "AIKO407": ("error", "invalid gateway HA/journal policy spec"),
     "AIKO408": ("error", "invalid prefill/decode disaggregation spec"),
+    "AIKO409": ("error", "invalid decode checkpoint/recovery policy "
+                         "spec"),
     # -- AIKO5xx: profile-guided tuning (tune/) --------------------------
     "AIKO501": ("error", "invalid tune SLO/directive spec"),
     "AIKO502": ("warning", "tune recommendation not applicable to the "

@@ -14,11 +14,12 @@ from .diagnostics import AnalysisReport, Diagnostic
 from .grammar import Field, GrammarError, split_directives
 
 __all__ = ["run_policy_pass", "check_gateway_policy",
-           "check_autoscale_policy", "check_disagg_policy",
-           "check_faults_spec", "check_journal_policy",
-           "check_decode_parameters", "check_tune_spec",
-           "parse_speculative_spec", "FAULT_TOLERANCE_FIELDS",
-           "DECODE_FIELDS", "DISAGG_FIELDS", "SPECULATIVE_FIELDS"]
+           "check_autoscale_policy", "check_checkpoint_policy",
+           "check_disagg_policy", "check_faults_spec",
+           "check_journal_policy", "check_decode_parameters",
+           "check_tune_spec", "parse_speculative_spec",
+           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS", "DISAGG_FIELDS",
+           "SPECULATIVE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -131,6 +132,15 @@ def check_decode_parameters(parameters: dict,
                                           parameters[key])
             except ValueError as error:
                 problems.append(("AIKO408", str(error)))
+        if "checkpoint" in parameters:
+            # the warm-KV-failover snapshot spec (decode/checkpoint.py)
+            # is engine-scoped here: recovery_rate belongs on the
+            # gateway's `checkpoint` / the `checkpoint_policy` parameter
+            checkpoint_problems = check_checkpoint_policy(
+                parameters["checkpoint"], element=True)
+            problems.extend(checkpoint_problems)
+            if not checkpoint_problems:
+                clean["checkpoint"] = parameters["checkpoint"]
     if "speculative" in clean:
         try:
             parse_speculative_spec(clean["speculative"])
@@ -169,6 +179,17 @@ def check_decode_parameters(parameters: dict,
             "AIKO408",
             "adopt_timeout only applies to role=decode (the adopting "
             "side of the KV migration)"))
+    if "checkpoint" in clean:
+        if role == "prefill":
+            problems.append((
+                "AIKO409",
+                "role=prefill holds no decode state to checkpoint; "
+                "drop checkpoint"))
+        elif not clean.get("continuous"):
+            problems.append((
+                "AIKO409",
+                "checkpoint requires continuous=true (snapshots ride "
+                "the slot engine)"))
     if problems or not clean.get("continuous"):
         return problems
     block_size = clean.get("kv_block_size", 16)
@@ -277,6 +298,28 @@ def check_disagg_policy(spec) -> list:
     return problems
 
 
+def check_checkpoint_policy(spec, element: bool = False) -> list:
+    """(code, message) problems in a warm-KV-failover checkpoint spec
+    (rule code AIKO409).  Same shape as check_disagg_policy: the
+    per-directive grammar check, then the REAL CheckpointPolicy.parse
+    plus its scope validation -- `recovery_rate` is gateway-side
+    (failover pacing), `checkpoint_every`/`max_checkpoint_lag` are
+    engine-side (snapshot cadence) -- so a spec on the wrong side
+    fails offline exactly as at construction."""
+    from ..decode.checkpoint import CHECKPOINT_GRAMMAR, CheckpointPolicy
+    problems = CHECKPOINT_GRAMMAR.check(spec, value_code="AIKO409")
+    if not problems:
+        try:
+            policy = CheckpointPolicy.parse(spec)
+            if element:
+                policy.validate_engine()
+            else:
+                policy.validate_gateway()
+        except ValueError as error:
+            problems.append(("AIKO409", str(error)))
+    return problems
+
+
 def check_autoscale_policy(spec) -> list:
     """(code, message) problems in an elastic-fleet autoscale spec.
     Same shape as check_gateway_policy: the per-directive grammar
@@ -321,12 +364,29 @@ def run_policy_pass(definition) -> AnalysisReport:
             and (element.deploy_local or {}).get("class_name")
             == "LMGenerate")
         triggers = (tuple(DECODE_FIELDS)
-                    + (tuple(DISAGG_FIELDS) if disagg_scope else ()))
+                    + ((tuple(DISAGG_FIELDS) + ("checkpoint",))
+                       if disagg_scope else ()))
         if any(key in parameters for key in triggers):
             for code, message in check_decode_parameters(
                     parameters, disagg_scope=disagg_scope):
                 report.add(Diagnostic(code, message, definition=name,
                                       element=element_name))
+            if (disagg_scope and parameters.get("checkpoint")
+                    and element is not None
+                    and not any(
+                        str(port.get("name")) == "restore"
+                        for port in (element.input or []))):
+                # without the optional `restore` input port the
+                # gateway's failover hint is dropped by map_in: the
+                # element pays the snapshot tax every tick but every
+                # failover silently re-prefills cold
+                report.add(Diagnostic(
+                    "AIKO409",
+                    "checkpoint is set but the element declares no "
+                    "`restore` input port (add {\"name\": \"restore\", "
+                    "\"optional\": true}): failover hints would be "
+                    "dropped and every recovery re-prefills cold",
+                    definition=name, element=element_name))
     faults_spec = (definition.parameters or {}).get("faults")
     if faults_spec:
         for code, message in check_faults_spec(faults_spec):
@@ -348,6 +408,14 @@ def run_policy_pass(definition) -> AnalysisReport:
         if disagg_spec:
             for code, message in check_disagg_policy(disagg_spec):
                 report.add(Diagnostic(code, message, definition=name))
+    # `checkpoint_policy` is the gateway-side warm-failover spec
+    # embedded next to the definition (element-level `checkpoint` specs
+    # are checked engine-scoped through check_decode_parameters above)
+    checkpoint_spec = (definition.parameters or {}).get(
+        "checkpoint_policy")
+    if checkpoint_spec:
+        for code, message in check_checkpoint_policy(checkpoint_spec):
+            report.add(Diagnostic(code, message, definition=name))
     journal_spec = (definition.parameters or {}).get("journal_policy")
     if journal_spec:
         for code, message in check_journal_policy(journal_spec):

@@ -12,6 +12,11 @@
 #               blocks exported as a transfer-plane descriptor tree
 #               that DecodeEngine.adopt_request fetches into a free
 #               slot over the transfer plane (no re-prefill)
+#   checkpoint.py  warm KV failover -- DecodeCheckpointer ships
+#               incremental decode-state snapshots to a
+#               CheckpointKeeper so a crashed replica's streams
+#               restore on a survivor (DecodeEngine.restore_request)
+#               instead of re-prefilling; AIKO409 policy grammar
 #
 # Device kernels live in models/transformer.py (init_paged_pool,
 # paged_prefill, paged_prefill_chunk, paged_decode_step,
@@ -21,8 +26,15 @@
 from .blocks import BlockManager, TRASH_BLOCK      # noqa: F401
 from .engine import Completion, DecodeEngine, StepReport  # noqa: F401
 from .disagg import (                              # noqa: F401
-    HANDOFF_SCHEMA, PrefillEngine, fetch_kv_blocks)
+    HANDOFF_SCHEMA, PrefillEngine, fetch_kv_blocks,
+    offer_pool_blocks)
+from .checkpoint import (                          # noqa: F401
+    CHECKPOINT_SCHEMA, CheckpointKeeper, CheckpointPolicy,
+    DecodeCheckpointer, get_keeper, register_keeper, reset_keepers)
 
-__all__ = ["BlockManager", "TRASH_BLOCK", "Completion", "DecodeEngine",
-           "HANDOFF_SCHEMA", "PrefillEngine", "StepReport",
-           "fetch_kv_blocks"]
+__all__ = ["BlockManager", "TRASH_BLOCK", "CHECKPOINT_SCHEMA",
+           "CheckpointKeeper", "CheckpointPolicy", "Completion",
+           "DecodeCheckpointer", "DecodeEngine", "HANDOFF_SCHEMA",
+           "PrefillEngine", "StepReport", "fetch_kv_blocks",
+           "get_keeper", "offer_pool_blocks", "register_keeper",
+           "reset_keepers"]

@@ -51,11 +51,36 @@ from ..utils import get_logger
 from ..utils.padding import bucket_length
 from .blocks import TRASH_BLOCK, BlockManager
 
-__all__ = ["HANDOFF_SCHEMA", "PrefillEngine", "fetch_kv_blocks"]
+__all__ = ["HANDOFF_SCHEMA", "PrefillEngine", "fetch_kv_blocks",
+           "offer_pool_blocks"]
 
 _LOGGER = get_logger("prefill_engine")
 
 HANDOFF_SCHEMA = "aiko.kv_handoff/1"
+
+
+def offer_pool_blocks(pool: dict, block_ids) -> tuple:
+    """Offer `block_ids`' slices of every pool leaf on this process's
+    transfer server as RAW descriptors (never `{__tensorref__: ...}`
+    marker nodes -- see fetch_kv_blocks); returns (kv_blocks, bytes)
+    where kv_blocks is one {leaf_name: descriptor} dict per block.
+    Shared by PrefillEngine's handoff export and the decode-state
+    checkpointer (decode/checkpoint.py): one device->host gather per
+    leaf, then per-block host views."""
+    server = get_transfer_server()
+    block_ids = np.asarray(block_ids)
+    host = {name: np.asarray(leaf[:, block_ids])
+            for name, leaf in pool.items()}
+    kv_blocks = []
+    total_bytes = 0
+    for index in range(len(block_ids)):
+        entry = {}
+        for name in sorted(host):
+            view = host[name][:, index]
+            total_bytes += view.nbytes
+            entry[name] = server.offer(view)
+        kv_blocks.append(entry)
+    return kv_blocks, total_bytes
 
 
 def fetch_kv_blocks(handoff: dict, timeout: float | None = None) -> dict:
@@ -282,24 +307,12 @@ class PrefillEngine:
         travel: the bucket-padding tail past true_len is garbage the
         adopting engine overwrites before its gather reaches it, and
         whole blocks past the prompt hold nothing at all."""
-        server = get_transfer_server()
         used = self.blocks.blocks_for(job.true_len)
-        block_ids = np.asarray(job.blocks[:used])
-        # one device->host gather per leaf, then per-block host views
-        host = {name: np.asarray(leaf[:, block_ids])
-                for name, leaf in self.pool.items()}
-        kv_blocks = []
-        total_bytes = 0
-        for index in range(used):
-            entry = {}
-            for name in sorted(host):
-                view = host[name][:, index]
-                total_bytes += view.nbytes
-                # RAW descriptor, not a {TENSOR_REF_KEY: ...} marker:
-                # see fetch_kv_blocks -- the frame codec must carry
-                # these inert so the ADOPTING engine batch-fetches
-                entry[name] = server.offer(view)
-            kv_blocks.append(entry)
+        # RAW descriptors, not {TENSOR_REF_KEY: ...} markers: see
+        # fetch_kv_blocks -- the frame codec must carry these inert so
+        # the ADOPTING engine batch-fetches
+        kv_blocks, total_bytes = offer_pool_blocks(
+            self.pool, job.blocks[:used])
         self.blocks.free(job.blocks)
         job.blocks = []
         self._active = None

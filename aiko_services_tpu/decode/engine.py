@@ -218,7 +218,9 @@ class DecodeEngine:
                          "chunk_interleaves": 0, "spec_windows": 0,
                          "spec_drafted": 0, "spec_accepted": 0,
                          "adopted": 0, "adopt_fallbacks": 0,
-                         "kv_migrated_bytes": 0}
+                         "kv_migrated_bytes": 0, "restores": 0,
+                         "restore_fallbacks": 0,
+                         "restore_replayed_tokens": 0}
         self._update_gauges()
 
     # -- submission --------------------------------------------------------
@@ -256,6 +258,52 @@ class DecodeEngine:
             submitted_at=time.perf_counter()))
         self._update_gauges()
 
+    def _ingest_kv_blocks(self, record: dict, needed: int,
+                          timeout, fallback, what: str):
+        """The shared CONSUMER half of both KV migrations -- prefill
+        handoff adoption and checkpoint restore: allocate `needed`
+        blocks, batch-fetch `record`'s raw block descriptors (ONE
+        connection per producing peer), and scatter them into the
+        pool.  Returns (granted_blocks, migrated_bytes); on ANY
+        failure the grant is returned to the free list, `fallback`
+        runs with the reason, and (None, 0) comes back."""
+        from .disagg import fetch_kv_blocks
+
+        granted = self.blocks.allocate(needed)
+        if granted is None:
+            fallback("pool exhausted")
+            return None, 0
+        try:
+            leaves = fetch_kv_blocks(record, timeout=timeout)
+        except (KeyError, ValueError) as error:
+            # TransferError subclasses ValueError; expired keys raise
+            # KeyError -- either way the prompt re-prefills locally
+            self.blocks.free(granted)
+            fallback(f"KV fetch failed: {error}")
+            return None, 0
+        migrated = 0
+        indices = np.asarray(granted)
+        for name, stacked in leaves.items():
+            if name not in self.pool:
+                self.blocks.free(granted)
+                fallback(f"{what} leaf {name!r} not in pool "
+                         f"(kv_dtype mismatch?)")
+                return None, 0
+            migrated += stacked.nbytes
+            try:
+                self.pool[name] = self.pool[name].at[:, indices].set(
+                    stacked)
+            except (TypeError, ValueError) as error:
+                # same leaf names + block size but different model
+                # geometry (mixed fleet / rolling reconfig): the
+                # scatter is where the mismatch surfaces, and it must
+                # degrade like every other path -- never leak the grant
+                self.blocks.free(granted)
+                fallback(f"{what} leaf {name!r} does not fit this "
+                         f"pool: {error}")
+                return None, 0
+        return granted, migrated
+
     def adopt_request(self, request_id, handoff: dict,
                       timeout: float | None = None) -> StepReport:
         """Adopt a remotely prefilled request MID-FLIGHT: fetch the
@@ -274,8 +322,6 @@ class DecodeEngine:
         ordinary admission path (decode.adopt_fallbacks counts it).
         Returns a StepReport carrying the first token's emission (and
         the completion, when max_new == 1)."""
-        from .disagg import fetch_kv_blocks
-
         report = StepReport()
         prompt = np.asarray(handoff["prompt"], np.int32).reshape(-1)
         max_new = int(handoff["max_new"])
@@ -307,26 +353,11 @@ class DecodeEngine:
             return fallback(
                 f"handoff carries {len(handoff.get('kv_blocks') or [])}"
                 f" blocks, prompt needs {needed}")
-        granted = self.blocks.allocate(needed)
-        if granted is None:
-            return fallback("pool exhausted")
         adopt_start = time.perf_counter()
-        try:
-            leaves = fetch_kv_blocks(handoff, timeout=timeout)
-        except (KeyError, ValueError) as error:
-            # TransferError subclasses ValueError; expired keys raise
-            # KeyError -- either way the prompt re-prefills locally
-            self.blocks.free(granted)
-            return fallback(f"KV fetch failed: {error}")
-        migrated = 0
-        indices = np.asarray(granted)
-        for name, stacked in leaves.items():
-            if name not in self.pool:
-                self.blocks.free(granted)
-                return fallback(f"handoff leaf {name!r} not in pool "
-                                f"(kv_dtype mismatch?)")
-            migrated += stacked.nbytes
-            self.pool[name] = self.pool[name].at[:, indices].set(stacked)
+        granted, migrated = self._ingest_kv_blocks(
+            handoff, needed, timeout, fallback, "handoff")
+        if granted is None:
+            return report
         # slot bookkeeping identical to a local prefill's end state
         request = _Request(
             request_id=request_id, prompt=prompt, max_new=max_new,
@@ -357,6 +388,152 @@ class DecodeEngine:
         self._bump("decode.kv_migrated_bytes", migrated)
         if self._registry is not None:
             self._registry.histogram("decode.adopt_ms").record(adopt_ms)
+        self._update_gauges()
+        return report
+
+    def restore_request(self, request_id, record,
+                        prompt_tokens=None, max_new_tokens=None,
+                        timeout: float | None = None,
+                        resume_from: int = 0) -> StepReport:
+        """Resume a request from a CHECKPOINT after its decode replica
+        died (decode/checkpoint.py): fetch the keeper's merged KV
+        blocks over the transfer plane, scatter them into a free slot,
+        restore the cursor + generated-token list, and continue greedy
+        decode from the snapshot position -- re-decoding only the (at
+        most max_checkpoint_lag) tokens generated after the snapshot,
+        which greedy determinism regenerates bit-identically, instead
+        of re-prefilling the whole prompt.
+
+        `resume_from` is the highest token offset already DELIVERED
+        downstream (a replaying client's hint): tokens below it
+        re-decode silently -- counted as
+        decode.restore_replayed_tokens -- and emission resumes
+        gaplessly at that offset.  Without a hint every restored token
+        DELIBERATELY re-emits with its original offset -- the
+        snapshot's own emitted floor is NOT trusted, because the dead
+        element may have buffered (never published) chunks the engine
+        already counted as surfaced -- so an offset-keyed consumer
+        assembles an exactly-once, gapless stream either way.
+
+        NEVER loses the request: a missing/stale/mismatched record, a
+        failed fetch, a full slot array, or an exhausted pool all FALL
+        BACK to a plain submit() -- the existing replay re-prefill --
+        with decode.restore_fallbacks counting the degradation."""
+        report = StepReport()
+        if record is not None:
+            prompt = np.asarray(record.get("prompt", ()),
+                                np.int32).reshape(-1)
+            max_new = int(record.get("max_new", max_new_tokens or 0))
+        else:
+            prompt = np.asarray(
+                () if prompt_tokens is None else prompt_tokens,
+                np.int32).reshape(-1)
+            max_new = int(max_new_tokens or 0)
+        if prompt.size < 1 or max_new < 1:
+            raise ValueError(
+                f"{request_id}: restore needs a prompt and "
+                f"max_new_tokens (from the record or the caller)")
+
+        def fallback(reason: str) -> StepReport:
+            _LOGGER.info("restore %r fell back to local re-prefill: "
+                         "%s", request_id, reason)
+            self.counters["restore_fallbacks"] += 1
+            self._bump("decode.restore_fallbacks", 1)
+            self.submit(request_id, prompt, max_new)
+            return report
+
+        if record is None:
+            return fallback("no checkpoint record")
+        generated = [int(token) for token in
+                     (record.get("generated") or ())]
+        if not generated:
+            return fallback("snapshot precedes the first token")
+        if int(record.get("block_size", 0)) != self.blocks.block_size:
+            return fallback(
+                f"block_size {record.get('block_size')} != pool's "
+                f"{self.blocks.block_size}")
+        true_len = int(record.get("true_len", prompt.size))
+        position = int(record.get("position", 0))
+        if position != true_len + len(generated) - 1:
+            return fallback(
+                f"inconsistent snapshot: position {position} != "
+                f"true_len {true_len} + {len(generated)} - 1")
+        free = [index for index, slot in enumerate(self.slots)
+                if slot is None]
+        if not free:
+            return fallback("no free slot")
+        worst = max(self._bucket(true_len), true_len + max_new)
+        if worst > self.max_context:
+            raise ValueError(
+                f"{request_id}: prompt {true_len} + max_new {max_new} "
+                f"exceeds max_context {self.max_context}")
+        needed = self.blocks.blocks_for(position)
+        if len(record.get("kv_blocks") or []) != needed:
+            return fallback(
+                f"snapshot carries "
+                f"{len(record.get('kv_blocks') or [])} blocks, "
+                f"position {position} needs {needed}")
+        restore_start = time.perf_counter()
+        granted, migrated = self._ingest_kv_blocks(
+            record, needed, timeout, fallback, "snapshot")
+        if granted is None:
+            return report
+        now = time.perf_counter()
+        request = _Request(
+            request_id=request_id, prompt=prompt, max_new=max_new,
+            submitted_at=now)
+        request.admitted_at = now
+        request.first_token_at = now
+        request.generated = generated
+        # the emission floor: tokens the downstream already holds are
+        # re-decoded (their K/V feeds later positions) but re-emission
+        # resumes at the floor, so streamed offsets stay gapless.  With
+        # a floor PAST the snapshot the gap is exactly the post-snapshot
+        # tokens the dead replica emitted -- the re-decode burden
+        # max_checkpoint_lag bounds
+        resume = max(int(resume_from or 0), 0)
+        replayed = max(resume - len(generated), 0)
+        request.emitted_upto = min(resume, max_new)
+        bucket = self._bucket(true_len)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:true_len] = prompt
+        index = free[0]
+        slot = _Slot(request, granted, self._admission_seq, true_len,
+                     bucket, padded)
+        self._admission_seq += 1
+        slot.prefill_pos = true_len
+        self.slots[index] = slot
+        self.tables[index, :] = TRASH_BLOCK
+        self.tables[index, :needed] = granted
+        self.positions[index] = position
+        self.last_tokens[index, 0] = generated[-1]
+        if self.draft_params is not None:
+            # the draft's cache cannot restore from the target's
+            # snapshot: rebuild it from the prompt and let the pending
+            # window re-ingest the restored tail lazily -- proposals
+            # are only ever proposals, so correctness is unaffected
+            self._draft_prefill(index)
+            catchup = generated[max(len(generated) - 2, 0):]
+            slot.draft_pending = list(catchup)
+            self.draft_positions[index] = (
+                position + 1 - len(slot.draft_pending))
+        restore_ms = (time.perf_counter() - restore_start) * 1000.0
+        self.counters["restores"] += 1
+        self.counters["kv_migrated_bytes"] += migrated
+        self.counters["admitted"] += 1
+        self.counters["restore_replayed_tokens"] += replayed
+        report.admitted += 1
+        self._bump("decode.restores", 1)
+        self._bump("decode.admitted", 1)
+        self._bump("decode.kv_migrated_bytes", migrated)
+        if replayed:
+            self._bump("decode.restore_replayed_tokens", replayed)
+        if self._registry is not None:
+            self._registry.histogram("decode.restore_ms").record(
+                restore_ms)
+        self._surface(report, request)
+        if self._finished(request):
+            report.completions.append(self._complete(index))
         self._update_gauges()
         return report
 

@@ -305,13 +305,13 @@ class LMGenerate(ComputeElement):
         return tokens, prompts
 
     def process_frame(self, stream, tokens=None, text=None,
-                      handoff=None):
+                      handoff=None, restore=None):
         import contextlib
         if self.disagg_role(stream) == "prefill":
             return self._process_frame_prefill(stream, tokens, text)
         if self.engine_managed(stream):
             return self._process_frame_continuous(stream, tokens, text,
-                                                  handoff)
+                                                  handoff, restore)
         self._ensure_ready()
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
         formatted = None
@@ -442,6 +442,27 @@ class LMGenerate(ComputeElement):
             registry=registry)
         self._engine_frames = {}
         self._pump_posted = False
+        self._checkpointer = None
+        spec = self.get_parameter("checkpoint")
+        if spec:
+            # warm KV failover (decode/checkpoint.py): ship incremental
+            # decode-state snapshots to the named keeper so a crash
+            # restores on a survivor instead of re-prefilling.  The
+            # spec parses through the AIKO409 grammar -- a bad value
+            # fails here with the same message `aiko lint` reports
+            from ..decode.checkpoint import (
+                CheckpointPolicy, DecodeCheckpointer)
+            # parse the spec AS-IS: the grammar accepts both directive
+            # strings and dicts, and lint checked the same value --
+            # stringifying a dict here would reject what lint admitted
+            policy = CheckpointPolicy.parse(spec)
+            policy.validate_engine()
+            on_checkpoint = (telemetry.record_checkpoint
+                             if telemetry is not None
+                             and telemetry.enabled else None)
+            self._checkpointer = DecodeCheckpointer(
+                self._engine, policy, registry=registry,
+                node=self.definition.name, on_checkpoint=on_checkpoint)
         return self._engine
 
     def _speculative_setup(self):
@@ -607,7 +628,7 @@ class LMGenerate(ComputeElement):
         return None if engine is None else engine.stats()
 
     def _process_frame_continuous(self, stream, tokens, text,
-                                  handoff=None):
+                                  handoff=None, restore=None):
         import time
         engine = self._ensure_engine()
         formatted = None
@@ -660,6 +681,9 @@ class LMGenerate(ComputeElement):
                         self._finish_request(completion)
                 self._note_adopt_span(stream, key,
                                       time.perf_counter() - adopt_s)
+            elif restore:
+                self._restore_rows(stream, key, tokens, max_new,
+                                   restore)
             else:
                 for row in range(rows):
                     engine.submit(key + (row,), tokens[row], max_new)
@@ -669,6 +693,65 @@ class LMGenerate(ComputeElement):
             raise
         self._schedule_pump()
         return StreamEvent.PENDING, None
+
+    def _restore_rows(self, stream, key, tokens, max_new,
+                      restore) -> None:
+        """Warm failover (decode/checkpoint.py): a gateway replaying a
+        dead decode replica's frames attached a RESTORE hint naming
+        the checkpoint keeper.  Each row asks the keeper for its
+        snapshot (keyed by (stream_id, frame_id, row) -- stable across
+        replicas) and resumes via engine.restore_request; a missing/
+        stale/unfetchable snapshot degrades to the ordinary re-prefill
+        inside restore_request, so the frame is never lost.  The
+        optional `resume_from` map (row -> highest token offset the
+        client already holds) makes re-emission resume gaplessly."""
+        import time
+        from ..decode.checkpoint import get_keeper
+        engine = self._engine
+        hint = restore if isinstance(restore, dict) else {}
+        keeper = get_keeper(str(hint.get("keeper") or ""))
+        resume_map = hint.get("resume_from") or {}
+        timeout = self.get_parameter("adopt_timeout", None, stream)
+        restore_s = time.perf_counter()
+        entry = self._engine_frames[key]
+        for row in range(tokens.shape[0]):
+            request_key = key + (row,)
+            record = None
+            if keeper is not None:
+                try:
+                    record = keeper.restore(request_key)
+                except (KeyError, ValueError) as error:
+                    _LOGGER.info("%s: keeper has no snapshot for %r "
+                                 "(%s); re-prefilling",
+                                 self.definition.name, request_key,
+                                 error)
+            resume = int(resume_map.get(row,
+                                        resume_map.get(str(row), 0))
+                         or 0)
+            restores_before = engine.counters["restores"]
+            report = engine.restore_request(
+                request_key, record, prompt_tokens=tokens[row],
+                max_new_tokens=max_new,
+                timeout=(float(timeout) if timeout else None),
+                resume_from=resume)
+            if (resume and entry["stream_tokens"]
+                    and engine.counters["restores"] > restores_before):
+                # a RESTORED row resumes emission at the client's
+                # floor: the chunk buffer must publish offsets from
+                # there, not from 0 -- an offset-keyed consumer would
+                # otherwise overwrite its held prefix with later
+                # tokens.  A FALLBACK row re-prefills and re-emits
+                # from offset 0, so its buffer keeps the default start
+                entry["buffers"][row] = [min(resume, max_new), []]
+            for rid, _offset, token in report.emitted:
+                self._buffer_streamed_token(rid, token)
+            for completion in report.completions:
+                self._finish_request(completion)
+        # restores ride the adopt span category: both are KV
+        # migrations, and tune's migration-bound classifier should see
+        # failover restores exactly as it sees prefill-pool adoptions
+        self._note_adopt_span(stream, key,
+                              time.perf_counter() - restore_s)
 
     def _note_adopt_span(self, stream, key, elapsed_s: float) -> None:
         """Record the adopt (KV-migration) span on the frame trace so
@@ -700,6 +783,10 @@ class LMGenerate(ComputeElement):
                 self._buffer_streamed_token(request_id, token)
             for completion in report.completions:
                 self._finish_request(completion)
+            if getattr(self, "_checkpointer", None) is not None:
+                # one cadence tick per engine step; tick() never raises
+                # (a failed snapshot keeps the keeper's previous one)
+                self._checkpointer.tick()
         except Exception as error:
             # the mailbox swallows exceptions, so an unguarded failure
             # here (device error, tokenizer crash) would strand every
@@ -719,6 +806,7 @@ class LMGenerate(ComputeElement):
                       len(self._engine_frames), error)
         frames, self._engine_frames = self._engine_frames, {}
         self._engine = None
+        self._checkpointer = None  # rebuilt with the engine
         for stream_id, frame_id in frames:
             self.pipeline.post_message("process_frame_response", [
                 {"stream_id": stream_id, "frame_id": frame_id,
@@ -753,6 +841,12 @@ class LMGenerate(ComputeElement):
 
     def _finish_request(self, completion):
         import time
+        checkpointer = getattr(self, "_checkpointer", None)
+        if checkpointer is not None:
+            # a cleanly finished request's snapshots are dead weight on
+            # the keeper; FENCED streams (failover) deliberately skip
+            # this -- their snapshots are what the survivor restores
+            checkpointer.forget(completion.request_id)
         stream_id, frame_id, row = completion.request_id
         key = (stream_id, frame_id)
         entry = self._engine_frames.get(key)
@@ -821,6 +915,12 @@ class LMGenerate(ComputeElement):
         first continuous frame."""
         engine = getattr(self, "_engine", None)
         return None if engine is None else engine.stats()
+
+    def checkpoint_stats(self) -> dict | None:
+        """Live decode-checkpointer counters; None when the element
+        runs without a `checkpoint` spec (or before the engine)."""
+        checkpointer = getattr(self, "_checkpointer", None)
+        return None if checkpointer is None else checkpointer.stats()
 
     def compute(self, state, **inputs):  # pragma: no cover
         raise NotImplementedError("LMGenerate overrides process_frame")

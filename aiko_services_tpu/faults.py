@@ -40,6 +40,11 @@
 #                    consulted like process_kill, but named so a chaos
 #                    plan reads as intent: the election/reap path is
 #                    the thing under test)
+#   transfer_stall   a transfer-plane SERVER answers one accepted
+#                    connection only after ms= of silence (a wedged
+#                    keeper/producer): the client's socket timeout --
+#                    adopt_timeout on the KV-migration paths -- must
+#                    bound the caller and degrade to re-prefill
 #
 # Determinism contract: rate-based selection hashes (seed, point, node,
 # frame_id) -- the SAME frames are poisoned on every run with the same
@@ -88,7 +93,8 @@ __all__ = ["FaultInjector", "FAULTS_GRAMMAR", "create_injector",
 
 _POINTS = ("element_raise", "fetch_drop", "reply_blackhole",
            "dispatch_delay", "connection_drop", "replica_kill",
-           "process_kill", "broker_partition", "registrar_kill")
+           "process_kill", "broker_partition", "registrar_kill",
+           "transfer_stall")
 
 # The spec grammar above as a declarative table over the shared
 # directive-grammar core (analyze/grammar.py): parse and offline lint
@@ -252,6 +258,20 @@ class FaultInjector:
 
     def connection_drop(self) -> bool:
         return self._fire("connection_drop") is not None
+
+    def transfer_stall(self) -> float:
+        """Consume: stall THIS transfer-plane connection?  Returns the
+        injected server-side delay in SECONDS (0.0 = not fired).
+        Consulted by TensorTransferServer once per accepted connection
+        -- a keeper/producer that accepts but answers slowly -- so
+        `frame=k` stalls the k-th connection (per-rule call ordinal)
+        and `rate=` draws once per connection.  The CLIENT's socket
+        timeout (fetch/adopt/restore timeout), not the stall, bounds
+        the caller: the test contract is that adopt_timeout degrades a
+        slow keeper to a local re-prefill instead of wedging the
+        engine pump."""
+        rule = self._fire("transfer_stall")
+        return rule.ms / 1000.0 if rule is not None else 0.0
 
     def replica_kill(self, replica) -> bool:
         """Consume: should `replica` die now?  Consulted by the serving

@@ -57,6 +57,9 @@ class GatewayTelemetry:
         self.kv_migrations = registry.counter("gateway.kv_migrations")
         self.prefill_fallbacks = registry.counter(
             "gateway.prefill_fallbacks")
+        # warm KV failover (decode/checkpoint.py): migrated streams
+        # whose replay was deferred by the recovery_rate pacing window
+        self.recovery_paced = registry.counter("gateway.recovery_paced")
         self.time_to_healthy = registry.histogram(
             "gateway.time_to_healthy_ms")
         self.warm_spawns = registry.counter("gateway.spawns_warm")
@@ -139,6 +142,8 @@ class GatewayTelemetry:
             summary["prefill_routed"] = self.prefill_routed.value
             summary["kv_migrations"] = self.kv_migrations.value
             summary["prefill_fallbacks"] = self.prefill_fallbacks.value
+        if self.recovery_paced.value:
+            summary["recovery_paced"] = self.recovery_paced.value
         if self.latency.count:
             summary["admit_latency_p50_ms"] = round(
                 self.latency.quantile(0.5) * 1000, 3)

@@ -273,6 +273,22 @@ class PipelineTelemetry:
                 ("X", f"adopt:{node}", "engine",
                  now_us() - elapsed_s * 1e6, elapsed_s * 1e6, None))
 
+    def record_checkpoint(self, node: str, elapsed_s: float,
+                          checkpoint_bytes: int) -> None:
+        """One decode-state snapshot shipped (decode/checkpoint.py):
+        per-node latency histogram plus a GLOBAL engine span -- the
+        snapshot covers every due slot, so it belongs to no single
+        frame -- which the tune loader joins as `checkpoint:{node}`
+        and the classifier labels checkpoint-bound when it dominates
+        compute/queue/adopt."""
+        if not self.enabled:
+            return
+        self.registry.histogram("checkpoint_s:" + node).record(
+            elapsed_s)
+        self.tracer.span_global(
+            f"checkpoint:{node}", "engine", elapsed_s,
+            {"bytes": int(checkpoint_bytes)})
+
     # -- fault tolerance ---------------------------------------------------
 
     def record_retry(self, frame, node: str, attempt: int,
@@ -481,6 +497,21 @@ class PipelineTelemetry:
             summary["adopt_fallbacks"] = fallbacks
             summary["kv_migrated_bytes"] = self.registry.counter(
                 "decode.kv_migrated_bytes").value
+        checkpoints = self.registry.counter("decode.checkpoints").value
+        if checkpoints:
+            # warm KV failover: snapshot cadence + the restore ledger
+            # (restores = re-prefills avoided; fallbacks = degraded)
+            summary["checkpoints"] = checkpoints
+            summary["checkpoint_bytes"] = self.registry.counter(
+                "decode.checkpoint_bytes").value
+        restores = self.registry.counter("decode.restores").value
+        restore_fallbacks = self.registry.counter(
+            "decode.restore_fallbacks").value
+        if restores or restore_fallbacks:
+            summary["restores"] = restores
+            summary["restore_fallbacks"] = restore_fallbacks
+            summary["restore_replayed_tokens"] = self.registry.counter(
+                "decode.restore_replayed_tokens").value
         return summary
 
     def _publish_snapshot(self) -> None:

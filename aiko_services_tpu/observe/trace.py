@@ -133,6 +133,17 @@ class Tracer:
         self.global_events.append(("i", name, category, now_us(), 0.0,
                                    args))
 
+    def span_global(self, name: str, category: str, elapsed_s: float,
+                    args: dict | None = None) -> None:
+        """A finished duration event on the global/scheduler lane --
+        work that belongs to no single frame (a decode-state
+        checkpoint covering every active slot).  Rendered as an X
+        span ending now, so the tune loader can median it like any
+        frame-attributed span."""
+        self.global_events.append(
+            ("X", name, category, now_us() - elapsed_s * 1e6,
+             elapsed_s * 1e6, args))
+
     def _lane(self, stream_id: str) -> int:
         lane = self._stream_lanes.get(stream_id)
         if lane is None:

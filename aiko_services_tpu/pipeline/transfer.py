@@ -48,7 +48,7 @@ from ..observe.metrics import get_registry
 __all__ = [
     "TensorTransferServer", "TransferError", "fetch", "fetch_many",
     "get_transfer_server", "transfer_enabled", "transfer_threshold",
-    "reset_transfer_server",
+    "reset_transfer_server", "reset_circuits", "transfer_circuit_ms",
 ]
 
 _HEADER = struct.Struct("!Q")
@@ -108,6 +108,66 @@ def transfer_linger() -> float:
     extra memory to a few seconds' worth of traffic; redelivery resolves
     well inside that."""
     return float(os.environ.get("AIKO_TRANSFER_LINGER", "5"))
+
+
+def transfer_circuit_ms() -> float:
+    """Per-peer circuit-breaker window in milliseconds (0 disables).
+    A peer that exhausts a fetch's whole retry budget is marked dead
+    for this window; until it heals, every fetch/fetch_many against it
+    FAILS FAST with TransferError instead of burning the full
+    AIKO_TRANSFER_RETRIES x AIKO_TRANSFER_RETRY_MS budget on the
+    caller's event loop -- adoption and checkpoint-restore failures
+    drop straight to their local-re-prefill fallback."""
+    return float(os.environ.get("AIKO_TRANSFER_CIRCUIT_MS", "2000"))
+
+
+# (host, port) -> monotonic deadline until which the peer is presumed
+# dead.  Any SUCCESSFUL connection (including an expired-key reply:
+# the peer answered) closes the circuit early.
+_CIRCUITS: dict[tuple, float] = {}
+_CIRCUIT_LOCK = threading.Lock()
+
+
+def _circuit_open(address: tuple) -> bool:
+    if not _CIRCUITS:
+        return False  # lock-free fast path for the healthy fleet
+    with _CIRCUIT_LOCK:
+        deadline = _CIRCUITS.get(address)
+        if deadline is None:
+            return False
+        if time.monotonic() >= deadline:
+            del _CIRCUITS[address]
+            return False
+        return True
+
+
+def _trip_circuit(address: tuple) -> None:
+    window = transfer_circuit_ms()
+    if window <= 0:
+        return
+    with _CIRCUIT_LOCK:
+        _CIRCUITS[address] = time.monotonic() + window / 1000.0
+    get_registry().counter("transfer.peer_open_circuits").inc()
+
+
+def _close_circuit(address: tuple) -> None:
+    if not _CIRCUITS:
+        return
+    with _CIRCUIT_LOCK:
+        _CIRCUITS.pop(address, None)
+
+
+def _circuit_fast_fail(address: tuple) -> None:
+    get_registry().counter("transfer.circuit_fast_fails").inc()
+    raise TransferError(
+        f"transfer circuit open to {address[0]}:{address[1]} (peer "
+        f"marked dead for {transfer_circuit_ms():g} ms after "
+        f"exhausting its retry budget)")
+
+
+def reset_circuits() -> None:
+    with _CIRCUIT_LOCK:
+        _CIRCUITS.clear()
 
 
 def _advertised_host() -> str:
@@ -252,6 +312,15 @@ class TensorTransferServer:
     def _handle(self, conn: socket.socket):
         try:
             conn.settimeout(transfer_timeout())
+            injector = get_injector()
+            if injector is not None:
+                # seeded per-connection stall (faults.py transfer_stall):
+                # a wedged keeper/producer that accepts but never
+                # answers -- the client's socket timeout, not this
+                # sleep, bounds the caller
+                stall = injector.transfer_stall()
+                if stall > 0:
+                    time.sleep(stall)
             # the pipelined protocol writes a small header before each
             # buffer; Nagle + delayed ACK would turn every round trip
             # into a ~40 ms stall
@@ -328,6 +397,8 @@ def fetch(descriptor: dict, timeout: float | None = None,
     if retries is None:
         retries = transfer_retries()
     address = (descriptor["host"], int(descriptor["port"]))
+    if _circuit_open(address):
+        _circuit_fast_fail(address)
     metrics = get_registry()
     fetch_start = time.perf_counter()
     backoff = transfer_retry_backoff()
@@ -347,6 +418,8 @@ def fetch(descriptor: dict, timeout: float | None = None,
                 (length,) = _HEADER.unpack(header)
                 if length == 0:
                     metrics.counter("transfer.fetch_expired").inc()
+                    # the peer ANSWERED: it is alive, the key is gone
+                    _close_circuit(address)
                     raise KeyError(
                         f"tensor {descriptor['key']} expired at "
                         f"{address[0]}:{address[1]}")
@@ -355,6 +428,7 @@ def fetch(descriptor: dict, timeout: float | None = None,
         except OSError as error:
             metrics.counter("transfer.fetch_errors").inc()
             if attempt >= retries:
+                _trip_circuit(address)
                 raise TransferError(
                     f"tensor fetch from {address[0]}:{address[1]} "
                     f"failed after {attempt + 1} attempts: "
@@ -362,6 +436,7 @@ def fetch(descriptor: dict, timeout: float | None = None,
             metrics.counter("transfer.fetch_retries").inc()
             time.sleep(backoff * (2.0 ** attempt))
             attempt += 1
+    _close_circuit(address)
     metrics.counter("transfer.fetches").inc()
     metrics.counter("transfer.fetched_bytes").inc(length)
     metrics.histogram("transfer.fetch_s").record(
@@ -399,6 +474,8 @@ def fetch_many(descriptors, timeout: float | None = None,
         by_peer.setdefault(address, []).append(index)
     fetch_start = time.perf_counter()
     for address, indices in by_peer.items():
+        if _circuit_open(address):
+            _circuit_fast_fail(address)
         backoff = transfer_retry_backoff()
         attempt = 0
         remaining = list(indices)
@@ -424,6 +501,7 @@ def fetch_many(descriptors, timeout: float | None = None,
                         if length == 0:
                             metrics.counter(
                                 "transfer.fetch_expired").inc()
+                            _close_circuit(address)
                             raise KeyError(
                                 f"tensor {descriptor['key']} expired "
                                 f"at {address[0]}:{address[1]}")
@@ -440,6 +518,7 @@ def fetch_many(descriptors, timeout: float | None = None,
             except OSError as error:
                 metrics.counter("transfer.fetch_errors").inc()
                 if attempt >= retries:
+                    _trip_circuit(address)
                     raise TransferError(
                         f"batched tensor fetch from "
                         f"{address[0]}:{address[1]} failed after "
@@ -449,6 +528,7 @@ def fetch_many(descriptors, timeout: float | None = None,
                 metrics.counter("transfer.fetch_retries").inc()
                 time.sleep(backoff * (2.0 ** attempt))
                 attempt += 1
+        _close_circuit(address)
     metrics.histogram("transfer.fetch_s").record(
         time.perf_counter() - fetch_start)
     return results

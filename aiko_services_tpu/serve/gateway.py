@@ -190,7 +190,7 @@ class _GatewayStream:
                  "grace_time", "replica", "queue_response",
                  "topic_response", "throttle", "inflight", "delivered",
                  "delivered_floor", "cursor", "parked", "throttled",
-                 "lease", "prefill_created")
+                 "lease", "prefill_created", "keeper")
 
     def __init__(self, stream_id: str, priority: int, slo_ms: float,
                  parameters: dict, grace_time: float, replica: _Replica,
@@ -220,6 +220,10 @@ class _GatewayStream:
         # prefill replicas that already hold this stream (disagg hop 1
         # creates lazily on first dispatch to each prefill replica)
         self.prefill_created: set[str] = set()
+        # checkpoint keeper name this stream's restore hints carry:
+        # the gateway policy's keeper, or the journaled one after a
+        # takeover -- "checkpoint locations ride the gateway journal"
+        self.keeper: str | None = None
 
     def is_delivered(self, frame_id: int) -> bool:
         return (frame_id <= self.delivered_floor
@@ -231,7 +235,7 @@ class Gateway(Actor):
                  router_seed: int = 0, faults=None, telemetry: bool = True,
                  metrics_interval: float = 10.0, autoscale=None,
                  replica_factory=None, journal=None, ha=None,
-                 disagg=None):
+                 disagg=None, checkpoint=None):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
         # construction-time validation through the shared
         # directive-grammar core (analyze/grammar.py): a typo'd policy
@@ -265,6 +269,30 @@ class Gateway(Actor):
             raise ValueError(
                 f"{code}: gateway disagg policy rejected: "
                 f"{error}") from None
+        # warm KV failover (decode/checkpoint.py): with a checkpoint
+        # policy set, a dead decode replica's replayed frames carry a
+        # RESTORE hint (the keeper name) so the survivor adopts each
+        # stream's checkpointed decode state instead of re-prefilling,
+        # and the replay wave is PACED at recovery_rate streams/s so
+        # survivors' live decode is not convoyed by the recovery storm
+        try:
+            from ..decode.checkpoint import CheckpointPolicy
+            self.checkpoint = (CheckpointPolicy.parse(checkpoint)
+                               if checkpoint is not None else None)
+            if self.checkpoint is not None:
+                self.checkpoint.validate_gateway()
+        except ValueError as error:
+            code = ("AIKO404" if getattr(error, "kind", "") == "unknown"
+                    else "AIKO409")
+            raise ValueError(
+                f"{code}: gateway checkpoint policy rejected: "
+                f"{error}") from None
+        # stream_id -> {"ids": [frame ids], "hint": restore hint}:
+        # failover replays deferred by recovery pacing -- in inflight,
+        # neither dispatched nor parked.  The hint is FROZEN at
+        # failover time so the paced wave keeps _restore_hint's
+        # drain/prefill-pool guards
+        self._paced_frames: dict[str, dict] = {}
         self.replicas: dict[str, _Replica] = {}
         self.streams: dict[str, _GatewayStream] = {}
         # parked frames: (priority, seq, stream_id, frame_id), dispatched
@@ -352,7 +380,11 @@ class Gateway(Actor):
         # _LocalResponder): without this, an overload backlog in the
         # `in` mailbox starves every replica of slot-freeing responses
         if command in ("process_frame_response", "_release_dead_letter",
-                       "_replica_lost", "_autoscale_ready"):
+                       "_replica_lost", "_autoscale_ready",
+                       "_paced_replay"):
+            # _paced_replay rides CONTROL too: recovery waves fire
+            # exactly when the `in` mailbox is deepest, and a wave
+            # parked behind queued submissions would defeat the pacing
             from ..runtime import ActorTopic
             actor_topic = ActorTopic.CONTROL
         super()._post_message(actor_topic, command, parameters)
@@ -520,7 +552,7 @@ class Gateway(Actor):
             # identity/cursor anyway (the pin survives; the new primary
             # serves with replica-side parameters)
             parameters = {}
-        return {
+        record = {
             "stream_id": stream.stream_id,
             "priority": stream.priority,
             "slo_ms": stream.slo_ms,
@@ -533,6 +565,11 @@ class Gateway(Actor):
             "delivered_upto": stream.delivered_floor,
             "expires_at": epoch_now() + max(stream.grace_time, 0.0),
         }
+        if stream.keeper:
+            # checkpoint LOCATION rides the journal: a promoted
+            # standby's failovers restore from the same keeper
+            record["keeper"] = stream.keeper
+        return record
 
     def _bucket_levels(self) -> dict:
         return {str(priority): round(bucket.tokens, 6)
@@ -624,6 +661,11 @@ class Gateway(Actor):
             stream.cursor = parse_int(record.get("cursor", 0), 0)
             stream.delivered_floor = parse_int(
                 record.get("delivered_upto", -1), -1)
+            stream.keeper = (str(record.get("keeper"))
+                             if record.get("keeper") else
+                             (self.checkpoint.keeper
+                              if self.checkpoint is not None
+                              and self.checkpoint.keeper else None))
             stream.lease = Lease(
                 self.process.event, grace_time, stream_id,
                 lease_expired_handler=self._stream_lease_expired,
@@ -808,10 +850,29 @@ class Gateway(Actor):
         """Re-pin every stream pinned to `replica` and replay its
         un-acknowledged frames -- the zero-loss path shared by failover
         (replica death) and drain (scale-down).  The replica must
-        already be out of self.replicas so placement cannot choose it."""
+        already be out of self.replicas so placement cannot choose it.
+
+        Warm failover (decode/checkpoint.py): when a checkpoint keeper
+        is known, each replayed frame carries a RESTORE hint so the
+        new replica adopts the stream's checkpointed decode state
+        instead of re-prefilling.  Recovery-storm pacing: past the
+        first `recovery_rate`-sized wave, a stream's replay defers to
+        a scheduled `_paced_replay` at 1/recovery_rate spacing -- the
+        survivors' LIVE decode slots keep their cadence while the
+        re-admission wave (and its cold re-prefill fallbacks, bounded
+        per tick by the replicas' chunked prefill) trickles in."""
         for stream_id in list(replica.streams):
             self._send_destroy(replica, stream_id)
         now = time.monotonic()
+        # pacing protects survivors from a CRASH recovery storm; a
+        # graceful drain migrates at full speed (nothing crashed, the
+        # drained replica's work is finishing, survivors were sized
+        # for the load) -- mirroring _restore_hint's drain bypass
+        rate = (self.checkpoint.recovery_rate
+                if self.checkpoint is not None
+                and not replica.draining else 0.0)
+        immediate = max(int(rate), 1)
+        migrated = 0
         for stream_id in list(replica.streams):
             replica.streams.discard(stream_id)
             stream = self.streams.get(stream_id)
@@ -834,12 +895,15 @@ class Gateway(Actor):
             first = (min(stream.inflight) if stream.inflight
                      else stream.cursor)
             self._send_create(target, stream, first_frame_id=first)
+            hint = self._restore_hint(stream, replica)
             # replay in frame order; capacity overflow parks (original
             # seq keeps the parked entries draining in order).  Frames
             # that were still PARKED at death are already queued -- they
             # drain to the new replica through the re-pin above
             parked_ids = {item[3] for item in self._parked
                           if item[2] == stream_id}
+            already_paced = stream_id in self._paced_frames
+            replay_ids = []
             for frame_id in sorted(stream.inflight):
                 if frame_id in parked_ids:
                     continue
@@ -849,11 +913,83 @@ class Gateway(Actor):
                     # response re-dispatches through _prefill_done to
                     # the NEW pin -- replaying here would double-send
                     continue
-                if (target.has_capacity(self.policy)
-                        and stream.parked == 0):
-                    self._send_frame(target, stream, frame_id, entry)
-                else:
-                    self._park(stream, frame_id, entry[2])
+                replay_ids.append(frame_id)
+            if already_paced:
+                # a SECOND failover while this stream's replay wave is
+                # still scheduled: MERGE the new replay ids (frames
+                # dispatched after the first failover) into the
+                # pending wave -- _paced_replay reads stream.replica
+                # at fire time, so everything lands on the new pin;
+                # replaying here too would double-dispatch
+                pending = self._paced_frames[stream_id]
+                pending["ids"] = sorted(set(pending["ids"])
+                                        | set(replay_ids))
+                pending["hint"] = hint
+                continue
+            migrated += 1
+            if rate > 0 and migrated > immediate and replay_ids:
+                self._paced_frames[stream_id] = {"ids": replay_ids,
+                                                 "hint": hint}
+                self.telemetry.recovery_paced.inc()
+                self.post_message_later(
+                    "_paced_replay", [stream_id],
+                    (migrated - immediate) / rate)
+                continue
+            self._replay_frames(stream, replay_ids, hint)
+
+    def _restore_hint(self, stream: _GatewayStream,
+                      dead: _Replica) -> dict | None:
+        """The warm-failover hint a replayed frame carries: the keeper
+        name the new DECODE replica restores the stream's checkpointed
+        slots from.  None (cold replay) when no keeper is known, when
+        the dead replica was a prefill-pool member (it held no decode
+        state), or on a graceful drain's own migration (the drained
+        replica finished its work; there is nothing to restore)."""
+        keeper = stream.keeper or (
+            self.checkpoint.keeper if self.checkpoint is not None
+            else None)
+        if not keeper or dead.draining:
+            return None
+        if dead.pool_role() == "prefill":
+            return None
+        return {"keeper": keeper}
+
+    def _replay_frames(self, stream: _GatewayStream, frame_ids,
+                       hint: dict | None) -> None:
+        target = stream.replica
+        for frame_id in frame_ids:
+            entry = stream.inflight.get(frame_id)
+            if entry is None or stream.is_delivered(frame_id):
+                continue
+            if (target is not None
+                    and target.has_capacity(self.policy)
+                    and stream.parked == 0):
+                data = None
+                if hint is not None:
+                    data = dict(entry[0])
+                    data["restore"] = dict(hint)
+                self._send_frame(target, stream, frame_id, entry,
+                                 data=data)
+            else:
+                # parked frames replay the ORIGINAL data when they
+                # drain (the keeper snapshot may expire while parked):
+                # degraded to a re-prefill, never lost
+                self._park(stream, frame_id, entry[2])
+
+    def _paced_replay(self, stream_id) -> None:
+        """Scheduled continuation of a paced failover wave: dispatch
+        one migrated stream's replayed frames now.  Reads the CURRENT
+        pin, so a second failover (or drain) between scheduling and
+        firing lands the frames on the right replica; the restore hint
+        was frozen by _restore_hint at failover time, so its
+        drain/prefill-pool guards still hold."""
+        pending = self._paced_frames.pop(str(stream_id), None)
+        stream = self.streams.get(str(stream_id))
+        if not pending or not pending["ids"] or stream is None:
+            return
+        if stream.replica is None:
+            return
+        self._replay_frames(stream, pending["ids"], pending["hint"])
 
     # -- placement ---------------------------------------------------------
 
@@ -965,6 +1101,8 @@ class Gateway(Actor):
             stream_id, priority, slo_ms, parameters, grace_time, replica,
             queue_response=queue_response, topic_response=topic_response,
             throttle=throttle)
+        if self.checkpoint is not None and self.checkpoint.keeper:
+            stream.keeper = self.checkpoint.keeper
         stream.lease = Lease(
             self.process.event, grace_time, stream_id,
             lease_expired_handler=self._stream_lease_expired,
@@ -1080,6 +1218,11 @@ class Gateway(Actor):
             stream.lease = None
         parked_ids = {item[3] for item in self._parked
                       if item[2] == stream_id}
+        # paced failover replays that never fired behave like parked
+        # entries: in inflight, but no replica slot was ever taken
+        paced = self._paced_frames.pop(stream_id, None)
+        if paced is not None:
+            parked_ids |= set(paced["ids"])
         if stream.parked:
             self._parked = [item for item in self._parked
                             if item[2] != stream_id]
@@ -1536,6 +1679,7 @@ class Gateway(Actor):
                         {"stream_id": stream.stream_id,
                          "frame_id": frame_id, "event": "error"}]))
         stream.inflight.clear()
+        self._paced_frames.pop(stream.stream_id, None)
         if stream.parked:
             self._parked = [item for item in self._parked
                             if item[2] != stream.stream_id]

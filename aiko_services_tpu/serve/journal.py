@@ -15,7 +15,12 @@
 #   what          stream pins, cursors, delivered floors (dedupe),
 #                 bucket token levels -- METADATA only, never frame
 #                 payloads (clients replay un-acked frame DATA; the
-#                 journal guarantees the replay is deduped exactly-once)
+#                 journal guarantees the replay is deduped exactly-once).
+#                 With warm KV failover (decode/checkpoint.py) a
+#                 record also carries the stream's checkpoint KEEPER
+#                 name, so a promoted standby's decode-replica
+#                 failovers restore from the same keeper the dead
+#                 primary's would have
 #   when          stream admission / destruction is journaled at the
 #                 NEXT tick boundary along with the hot per-frame state
 #                 (cursor, floor), batched per `interval` tick -- one

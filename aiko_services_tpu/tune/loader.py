@@ -51,6 +51,9 @@ class ElementProfile:
     engine_decode_s: list = field(default_factory=list)
     # disaggregated adoption: KV-migration fetch + pool scatter spans
     engine_adopt_s: list = field(default_factory=list)
+    # warm KV failover: decode-state snapshot spans (global lane --
+    # a checkpoint covers every due slot, not one frame)
+    engine_checkpoint_s: list = field(default_factory=list)
     engine_preemptions: int = 0
     engine_tokens: int = 0
 
@@ -61,7 +64,8 @@ class ElementProfile:
     @property
     def is_engine_managed(self) -> bool:
         return bool(self.engine_prefill_s or self.engine_decode_s
-                    or self.engine_adopt_s)
+                    or self.engine_adopt_s
+                    or self.engine_checkpoint_s)
 
 
 @dataclass
@@ -284,6 +288,11 @@ def _ingest_events(loaded: LoadedTrace, events: list,
                 # migration (batched transfer-plane fetch + pool
                 # scatter) -- classified apart from slot-queue waits
                 profile.engine_adopt_s.append(span)
+            elif name.startswith("checkpoint:"):
+                # warm KV failover: time the engine pump spent
+                # building/offering decode-state snapshots -- a
+                # cadence set too hot floors the engine here
+                profile.engine_checkpoint_s.append(span)
             elif name.startswith("decode_steps:"):
                 profile.engine_decode_s.append(span)
                 args = event.get("args") or {}

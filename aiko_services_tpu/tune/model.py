@@ -19,6 +19,12 @@
 #                   the prefill pool is too remote/slow, not the
 #                   kernel -- fix the transfer path or colocate,
 #                   a bigger slot pool will not help
+#   checkpoint-bound a warm-failover decode element spends more wall
+#                   time shipping decode-state snapshots
+#                   (decode/checkpoint.py gathers + offers) than
+#                   computing or queueing: the snapshot cadence, not
+#                   the kernel, is the floor -- stretch
+#                   checkpoint_every / max_checkpoint_lag
 #   queue-bound     median scheduler wait exceeds median compute: the
 #                   element starves behind coalescing or a saturated
 #                   slot pool, not its own kernel
@@ -154,6 +160,9 @@ class CostModel:
                         profile.engine_decode_s),
                     "adopt_median_s": _median(profile.engine_adopt_s),
                     "adoptions": len(profile.engine_adopt_s),
+                    "checkpoint_median_s": _median(
+                        profile.engine_checkpoint_s),
+                    "checkpoints": len(profile.engine_checkpoint_s),
                     "preemptions": profile.engine_preemptions,
                     "tokens": profile.engine_tokens,
                     "requests": len(profile.engine_decode_s),
@@ -214,14 +223,24 @@ def classify_elements(model: CostModel) -> None:
         evidence["compile_ratio"] = round(compile_ratio, 4)
         engine_queue = (cost.engine or {}).get("queue_median_s", 0.0)
         engine_adopt = (cost.engine or {}).get("adopt_median_s", 0.0)
+        engine_checkpoint = (cost.engine or {}).get(
+            "checkpoint_median_s", 0.0)
         queue_wait = max(cost.queue_median_s, engine_queue)
         if cost.compiles and compile_ratio >= COMPILE_RATIO_BOUND:
             cost.floor = "compile-bound"
         elif engine_adopt > max(cost.compute_median_s, queue_wait,
-                                floor_s):
+                                engine_checkpoint, floor_s):
             # disaggregated adoption dominates: the KV migration, not
             # the kernel or the slot queue, is the floor
             cost.floor = "migration-bound"
+        elif engine_checkpoint > max(cost.compute_median_s, queue_wait,
+                                     floor_s):
+            # the warm-failover snapshot cadence dominates: the engine
+            # pump spends its ticks gathering/offering KV deltas, not
+            # decoding -- stretch checkpoint_every/max_checkpoint_lag
+            # (trading crash-time re-decode for hot-loop headroom), a
+            # bigger slot pool will not help
+            cost.floor = "checkpoint-bound"
         elif queue_wait > max(cost.compute_median_s, floor_s):
             cost.floor = "queue-bound"
         elif cost.per_call_median_s <= floor_s or (

@@ -240,6 +240,39 @@ def _engine_recommendations(name, cost, parameters, slo) -> list:
             "pools closer",
             floor=cost.floor, evidence=cost.evidence))
         return recommendations
+    if cost.floor == "checkpoint-bound":
+        # the warm-failover snapshot cadence floors this engine: the
+        # pump spends its ticks gathering/offering KV deltas.  Halve
+        # the snapshot frequency (double checkpoint_every, and lift
+        # max_checkpoint_lag to match so forced snapshots do not
+        # reinstate the old cadence) -- the price is a longer crash-
+        # time re-decode, bounded by the new max_checkpoint_lag
+        spec = str(parameters.get("checkpoint", "") or "")
+        keeper = ""
+        try:
+            from ..decode.checkpoint import CheckpointPolicy
+            policy = CheckpointPolicy.parse(spec)
+            current_every = policy.checkpoint_every
+            current_lag = policy.max_checkpoint_lag
+            keeper = policy.keeper
+        except ValueError:
+            current_every, current_lag = 8, 32
+        proposed_lag = max(current_lag, current_every * 2)
+        proposed = (f"checkpoint_every={current_every * 2};"
+                    f"max_checkpoint_lag={proposed_lag}")
+        if keeper:
+            # carry the keeper forward: a proposal that dropped it
+            # would silently DISABLE checkpointing when applied
+            proposed += f";keeper={keeper}"
+        recommendations.append(Recommendation(
+            f"element:{name}", "checkpoint", spec or None, proposed,
+            f"checkpoint-bound at {name}: snapshot shipping (median "
+            f"{engine.get('checkpoint_median_s', 0.0) * 1e3:.1f} ms) "
+            "dominates compute and queue wait -- stretch the cadence "
+            "(crash-time re-decode grows to the new "
+            "max_checkpoint_lag, hot-loop headroom returns)",
+            floor=cost.floor, evidence=cost.evidence))
+        return recommendations
     if engine.get("queue_median_s", 0.0) > max(compute, 1e-9):
         proposed = min(slots * 2, 64)
         if proposed > slots:

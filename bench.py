@@ -1987,6 +1987,7 @@ def bench_chaos(peak, seed: int | None = None):
         "topology": ("registrar pair + 2 wire-discovered replicas + "
                      "HA gateway pair, loopback broker"),
     }
+    result["decode_replica_kill"] = _chaos_decode_replica_kill(seed)
     timeline_path = os.environ.get("AIKO_CHAOS_TIMELINE")
     if timeline_path:
         try:
@@ -1997,6 +1998,265 @@ def bench_chaos(peak, seed: int | None = None):
         except OSError as error:
             result["timeline_error"] = str(error)
     return result
+
+
+def _chaos_decode_definition(name, max_new=24, slots=6,
+                             keeper="bench_ckpt_keeper"):
+    """One checkpointed continuous decode replica (warm KV failover):
+    the `decode_replica_kill` scenario's definition, also collected
+    into the `aiko lint --bench` surface so its AIKO405/408/409
+    parameter set stays strict-mode clean."""
+    return {
+        "name": name,
+        "parameters": {"telemetry": TELEMETRY,
+                       "metrics_interval": 60.0},
+        "graph": ["(lm)"],
+        "elements": [
+            {"name": "lm",
+             "input": [{"name": "tokens", "type": "any"},
+                       {"name": "restore", "type": "any",
+                        "optional": True}],
+             "output": [{"name": "generated", "type": "any"}],
+             "parameters": {
+                 "vocab_size": 300, "d_model": 32, "n_layers": 1,
+                 "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+                 "max_seq_len": 128, "dtype": "float32",
+                 "max_new_tokens": max_new, "continuous": True,
+                 "decode_slots": slots, "kv_block_size": 8,
+                 "stream_tokens": True, "stream_chunk": 1,
+                 "checkpoint": (f"checkpoint_every=1;"
+                                f"max_checkpoint_lag=4;"
+                                f"keeper={keeper}")},
+             "deploy": {"local": {"module": ELEMENTS,
+                                  "class_name": "LMGenerate"}}},
+        ],
+    }
+
+
+def _chaos_decode_replica_kill(seed: int):
+    """Warm KV failover under a continuous-batching storm: a gateway
+    fronts two checkpointed decode replicas, a seeded plan kills one
+    MID-DECODE, and the paced failover replays every migrated stream
+    with a restore hint -- the survivor adopts each stream's
+    checkpointed KV (decode/checkpoint.py) and re-decodes at most
+    `max_checkpoint_lag` tokens instead of re-prefilling the prompt.
+    Two arms (kill vs uncrashed) must be BIT-IDENTICAL with
+    frames_lost == 0 and ZERO survivor recompiles in the measured
+    window; the published numbers are the reprefill-avoided fraction
+    and the recovery TTFT (kill -> first post-kill token per migrated
+    stream)."""
+    import threading
+
+    from aiko_services_tpu.decode import CheckpointKeeper, reset_keepers
+    from aiko_services_tpu.faults import create_injector
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process
+    from aiko_services_tpu.serve import Gateway
+    from aiko_services_tpu.transport import reset_brokers
+    from aiko_services_tpu.utils import parse
+
+    import numpy as np
+
+    streams_n = 6 if SMOKE else 12
+    max_new = 24 if SMOKE else 48
+    prompt_len = 6
+    keeper_name = "bench_ckpt_keeper"
+    checkpoint_spec = (f"checkpoint_every=1;max_checkpoint_lag=4;"
+                       f"keeper={keeper_name}")
+    rng = np.random.default_rng(seed)
+    frames = [rng.integers(1, 300, size=(1, prompt_len))
+              .astype(np.int32) for _ in range(streams_n)]
+
+    def lm_definition(name):
+        return _chaos_decode_definition(name, max_new=max_new,
+                                        slots=streams_n,
+                                        keeper=keeper_name)
+
+    def wait(predicate, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.005)
+        raise TimeoutError("decode_replica_kill condition not met")
+
+    def run(kill: bool):
+        reset_keepers()
+        keeper = CheckpointKeeper(keeper_name)
+        processes = []
+
+        def make_process():
+            process = Process(transport_kind="loopback")
+            processes.append(process)
+            return process
+
+        replica_a = create_pipeline(make_process(),
+                                    lm_definition("ck_dec0"))
+        replica_b = create_pipeline(make_process(),
+                                    lm_definition("ck_dec1"))
+        gateway_process = make_process()
+        gateway = Gateway(
+            gateway_process, policy="max_inflight=32;queue=128",
+            router_seed=seed, metrics_interval=60.0,
+            checkpoint=f"recovery_rate=4;keeper={keeper_name}")
+        # all streams pin to replica A; B joins as the warm standby
+        # right before the kill, so the failover wave lands on it
+        gateway.attach_replica(replica_a)
+        lock = threading.Lock()
+        token_times: dict = {}    # (stream, offset) -> first-seen time
+
+        def on_out(topic, payload):
+            try:
+                command, parameters = parse(payload)
+            except ValueError:
+                return
+            if command != "token_chunk" or len(parameters) < 5:
+                return
+            now = time.perf_counter()
+            stream_id = str(parameters[0])
+            offset = int(parameters[3])
+            with lock:
+                for j in range(len(parameters[4][0])):
+                    token_times.setdefault((stream_id, offset + j),
+                                           now)
+
+        for pipe in (replica_a, replica_b):
+            pipe.process.add_message_handler(
+                on_out, f"{pipe.elements['lm'].topic_path}/out")
+        for process in processes:
+            process.run(in_thread=True)
+
+        # warm BOTH engines (the one prompt bucket + the decode step)
+        # before the measured window, so the survivor's recompile
+        # count during recovery is attributable to recovery alone
+        responses = queue.Queue()
+        for index, (name, pipe) in enumerate(
+                (("warm_a", replica_a), ("warm_b", replica_b))):
+            stream = pipe.create_stream(f"{name}", grace_time=300,
+                                        queue_response=responses)
+            pipe.create_frame(stream, {"tokens": frames[0]})
+            responses.get(timeout=120)
+            pipe.destroy_stream(f"{name}")
+        warm_compiles = {
+            "a": replica_a.elements["lm"].engine_stats()["compiles"],
+            "b": replica_b.elements["lm"].engine_stats()["compiles"]}
+
+        # frame=0: the kill fires on the plan's FIRST consult for this
+        # node (the harness consults once, at the seeded mid-storm
+        # point: every stream checkpointed, none finished)
+        injector = create_injector(
+            f"seed={seed};process_kill:node=ck_dec0:frame=0"
+        ) if kill else None
+        results = queue.Queue()
+        for index, frame in enumerate(frames):
+            gateway.submit_stream(f"s{index}", {},
+                                  queue_response=results)
+            gateway.submit_frame(f"s{index}", {"tokens": frame},
+                                 frame_id=0)
+        kill_at = None
+        migrated = []
+        if kill:
+            # mid-storm: every stream checkpointed, none finished
+            wait(lambda: keeper.flush(timeout=0.1)
+                 and keeper.kept_count() >= streams_n)
+            gateway.attach_replica(replica_b)
+            if injector.process_kill("ck_dec0"):
+                migrated = sorted(
+                    gateway.replicas[replica_a.topic_path].streams)
+                kill_at = time.perf_counter()
+                # a REAL death: sever + halt with no clean shutdown
+                # (Process.crash), so replica A emits nothing after
+                # kill_at and the recovery metrics measure the
+                # survivor's restores, not the victim's death throes
+                replica_a.process.crash()
+                gateway.post_message("_replica_lost", [
+                    replica_a.topic_path, "injected decode_replica_kill"])
+        outputs = {}
+        deadline = time.monotonic() + (120 if SMOKE else 300)
+        while len(outputs) < streams_n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                stream_id, _frame_id, out, status = results.get(
+                    timeout=remaining)
+            except queue.Empty:
+                break
+            if status == "ok":
+                outputs[stream_id] = np.asarray(
+                    out["generated"]).tolist()
+        survivor = replica_b.elements["lm"]
+        engine = survivor.engine_stats() or {}
+        recovery_ttft_ms = []
+        if kill_at is not None:
+            with lock:
+                times = dict(token_times)
+            for stream_id in migrated:
+                post = [t for (s, _o), t in times.items()
+                        if s == stream_id and t > kill_at]
+                if post:
+                    recovery_ttft_ms.append(
+                        (min(post) - kill_at) * 1000.0)
+        summary = gateway.telemetry.summary()
+        block = {
+            "outputs": outputs,
+            "frames_lost": streams_n - len(outputs),
+            "migrated_streams": len(migrated),
+            "restores": engine.get("restores", 0),
+            "restore_fallbacks": engine.get("restore_fallbacks", 0),
+            "restore_replayed_tokens": engine.get(
+                "restore_replayed_tokens", 0),
+            "recovery_paced": summary.get("recovery_paced", 0),
+            "compiles_in_window": (
+                (replica_b.elements["lm"].engine_stats()["compiles"]
+                 - warm_compiles["b"]) if kill else 0),
+            "checkpoints": (survivor.checkpoint_stats()
+                            or {}).get("checkpoints", 0),
+            "keeper": keeper.stats(),
+            "recovery_ttft_ms": sorted(recovery_ttft_ms),
+        }
+        for process in processes:
+            try:
+                process.terminate()
+            except Exception:
+                pass
+        reset_keepers()
+        reset_brokers()
+        return block
+
+    reference = run(kill=False)
+    chaotic = run(kill=True)
+    restores = chaotic["restores"]
+    fallbacks = chaotic["restore_fallbacks"]
+    ttft = chaotic["recovery_ttft_ms"]
+    block = {
+        "seed": seed,
+        "streams": streams_n,
+        "max_new_tokens": max_new,
+        "checkpoint_spec": checkpoint_spec,
+        "frames_lost": chaotic["frames_lost"],
+        "frames_lost_reference": reference["frames_lost"],
+        "bit_identical": chaotic["outputs"] == reference["outputs"],
+        "migrated_streams": chaotic["migrated_streams"],
+        "restores": restores,
+        "restore_fallbacks": fallbacks,
+        # the headline: migrated streams resumed from checkpoints
+        # instead of re-running their (compute-bound) prompt prefill
+        "reprefill_avoided_frac": round(
+            restores / max(restores + fallbacks, 1), 4),
+        "restore_replayed_tokens": chaotic["restore_replayed_tokens"],
+        "recovery_paced": chaotic["recovery_paced"],
+        "compiles_in_window": chaotic["compiles_in_window"],
+        "keeper": chaotic["keeper"],
+        "recovery_ttft_p50_ms": (round(ttft[len(ttft) // 2], 2)
+                                 if ttft else None),
+        "recovery_ttft_p99_ms": (round(ttft[min(
+            int(len(ttft) * 0.99), len(ttft) - 1)], 2)
+            if ttft else None),
+        "topology": ("2 checkpointed continuous decode replicas + "
+                     "standby keeper + paced gateway, loopback"),
+    }
+    return block
 
 
 # -- config 6b: continuous batching (decode/ engine) -------------------------
@@ -2884,6 +3144,7 @@ def collect_definitions() -> dict:
             {"preset": det_preset, "micro_batch": serving_micro,
              "dtype": "float32" if SMOKE else "bfloat16"}),
         "chaos": _chaos_definition("bench_chaos"),
+        "chaos_decode": _chaos_decode_definition("bench_chaos_decode"),
         "tts": _tts_definition(
             "hello" if SMOKE else
             "the quick brown fox jumps over the lazy dog",
