@@ -644,6 +644,96 @@ def _tune_apply(loaded, report, apply_path) -> int:
     return 0
 
 
+@main.group("trace")
+def trace_group() -> None:
+    """Fleet-scope distributed tracing: harvest per-process Perfetto
+    artifacts from a live fleet (`collect`) and merge many artifacts
+    into ONE clock-aligned timeline (`merge`) -- the input `aiko tune`
+    reads for cross-process (admission-bound) floor classification."""
+
+
+@trace_group.command("merge")
+@click.argument("output", type=click.Path())
+@click.argument("inputs", type=click.Path(exists=True), nargs=-1,
+                required=True)
+def trace_merge(output: str, inputs) -> None:
+    """Merge trace artifacts into OUTPUT.  Inputs are sorted (basename,
+    path) before merging, so the same file set always produces
+    byte-identical output -- CI diffs two merges to prove it."""
+    import sys
+
+    from .observe import merge_trace_files, trace_summary
+    try:
+        merged = merge_trace_files(list(inputs), output=output)
+    except (OSError, ValueError) as error:
+        click.echo(f"merge failed: {error}", err=True)
+        sys.exit(2)
+    summary = trace_summary(merged)
+    click.echo(
+        f"merged {len(inputs)} artifact(s) -> {output}: "
+        f"{len(merged['traceEvents'])} events, "
+        f"{summary['traces']} trace(s), "
+        f"{summary['multi_process_traces']} crossing processes "
+        f"(max {summary['max_processes_per_trace']} processes/trace), "
+        f"{summary['linked_spans']} parent-linked span(s)")
+    if summary["dangling_parents"]:
+        click.echo(
+            f"warning: {len(summary['dangling_parents'])} span(s) name "
+            f"a parent outside the merged set (partial harvest?)",
+            err=True)
+
+
+@trace_group.command("collect")
+@click.option("--output", "output_dir", type=click.Path(),
+              required=True,
+              help="Directory for the per-process artifacts")
+@click.option("--merge", "merge_path", type=click.Path(), default=None,
+              help="Also write the merged artifact here")
+@click.option("--transport", default=None)
+@click.option("--wait", default=3.0,
+              help="Discovery/response wait (s)")
+def trace_collect(output_dir: str, merge_path: str | None,
+                  transport: str | None, wait: float) -> None:
+    """Harvest every live pipeline/gateway's trace document over the
+    control plane (each replies to `(publish_trace ...)` with its
+    self-describing artifact) into per-process files, optionally
+    merged."""
+    import json as json_module
+    import sys
+    from pathlib import Path
+
+    from .observe import collect_traces, merge_trace_documents
+    from .runtime import Process
+    process = Process(transport_kind=transport)
+    process.run(in_thread=True)
+    try:
+        collected = collect_traces(process, wait=wait)
+    finally:
+        process.terminate()
+    if not collected:
+        click.echo("no traces collected (no live pipelines/gateways "
+                   "discovered, or telemetry disabled)", err=True)
+        sys.exit(2)
+    directory = Path(output_dir)
+    directory.mkdir(parents=True, exist_ok=True)
+    from .observe.collector import unique_source_name
+    named = []
+    seen: dict = {}
+    for source in sorted(collected):
+        safe = unique_source_name(
+            seen, source.replace("/", "_").strip("_"))
+        path = directory / f"{safe}.json"
+        path.write_text(json_module.dumps(collected[source],
+                                          sort_keys=True))
+        named.append((safe, collected[source]))
+        click.echo(f"collected {source} -> {path}")
+    if merge_path:
+        merged = merge_trace_documents(named)
+        Path(merge_path).write_text(json_module.dumps(
+            merged, sort_keys=True, separators=(",", ":")))
+        click.echo(f"merged {len(named)} artifact(s) -> {merge_path}")
+
+
 @main.command()
 def bench() -> None:
     """Run the standard benchmark (one JSON line)."""

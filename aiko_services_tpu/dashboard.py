@@ -117,6 +117,35 @@ def _gateway_plugin(model: "DashboardModel") -> list:
             f"  latency p50 {metrics.get('admit_latency_p50_ms')}ms "
             f"p99 {metrics.get('admit_latency_p99_ms')}ms")
     lines.append(admission_line)
+    slo = metrics.get("slo")
+    if isinstance(slo, dict):
+        # per-priority SLO attainment/burn (streams that declared
+        # slo_ms): the per-tenant accounting row
+        parts = []
+        for priority in sorted(
+                slo, key=lambda p: (not str(p).isdigit(),
+                                    int(p) if str(p).isdigit() else 0,
+                                    str(p))):
+            record = slo[priority]
+            if not isinstance(record, dict):
+                continue
+            attainment = record.get("attainment")
+            parts.append(
+                f"p{priority} {attainment if attainment is not None else '?'}"
+                f" ({record.get('ok', 0)}/{record.get('miss', 0)} "
+                f"ok/miss)")
+        if parts:
+            lines.append("slo: " + "  ".join(parts))
+    decomposition = metrics.get("stream_decomposition")
+    if isinstance(decomposition, dict):
+        total = decomposition.get("_total")
+        if isinstance(total, dict):
+            # fleet end-to-end decomposition: where admitted streams'
+            # latency went (admit+route+queue+prefill+decode+emit)
+            lines.append("e2e: " + "  ".join(
+                f"{stage} {total.get(stage)}ms"
+                for stage in ("admit", "route", "queue", "prefill",
+                              "decode", "emit") if stage in total))
     pool_line = (
         f"pool: size {metrics.get('pool_size', 0)}  "
         f"pending {metrics.get('pending_spawns', 0)}  "

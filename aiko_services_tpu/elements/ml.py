@@ -551,9 +551,21 @@ class LMGenerate(ComputeElement):
             tokens = tokens[None]
         max_new = int(self.get_parameter("max_new_tokens", 32, stream))
         key = (stream.stream_id, stream.current_frame_id)
+        # fleet tracing: the prefill frame's (possibly gateway-minted)
+        # trace context is frozen here so the finished KV handoff can
+        # carry it -- the adopting decode replica parents its adopt
+        # span under THIS prefill hop, not just the gateway root
+        frame = stream.frames.get(stream.current_frame_id)
+        trace = getattr(frame, "trace", None) if frame is not None \
+            else None
+        context = None
+        if trace is not None:
+            from ..observe.trace import make_trace_context
+            context = make_trace_context(trace)
         self._prefill_frames[key] = {
             "rows": tokens.shape[0], "done": {},
             "submitted_at": time.perf_counter(),
+            "trace_context": context,
         }
         try:
             for row in range(tokens.shape[0]):
@@ -593,6 +605,11 @@ class LMGenerate(ComputeElement):
             return  # stream destroyed mid-prefill
         record = dict(handoff)
         record["request_id"] = row  # peer-local identity, JSON-safe
+        if entry.get("trace_context"):
+            # the handoff DESCRIPTOR carries the trace context: even a
+            # handoff forwarded through a telemetry-disabled gateway
+            # still links decode's adopt span to this prefill hop
+            record["trace_context"] = entry["trace_context"]
         entry["done"][row] = record
         if len(entry["done"]) < entry["rows"]:
             return
@@ -671,7 +688,17 @@ class LMGenerate(ComputeElement):
                 timeout = self.get_parameter("adopt_timeout", None,
                                              stream)
                 adopt_s = time.perf_counter()
+                upstream = None
                 for row, record in enumerate(handoffs):
+                    if isinstance(record, dict) \
+                            and "trace_context" in record:
+                        # the prefill hop's trace identity rides the
+                        # handoff descriptor: strip it before the
+                        # engine sees the record, keep it as the adopt
+                        # span's parent link
+                        record = dict(record)
+                        upstream = record.pop("trace_context") or \
+                            upstream
                     report = engine.adopt_request(
                         key + (row,), record,
                         timeout=(float(timeout) if timeout else None))
@@ -680,7 +707,8 @@ class LMGenerate(ComputeElement):
                     for completion in report.completions:
                         self._finish_request(completion)
                 self._note_adopt_span(stream, key,
-                                      time.perf_counter() - adopt_s)
+                                      time.perf_counter() - adopt_s,
+                                      parent=upstream)
             elif restore:
                 self._restore_rows(stream, key, tokens, max_new,
                                    restore)
@@ -749,20 +777,28 @@ class LMGenerate(ComputeElement):
                 self._finish_request(completion)
         # restores ride the adopt span category: both are KV
         # migrations, and tune's migration-bound classifier should see
-        # failover restores exactly as it sees prefill-pool adoptions
-        self._note_adopt_span(stream, key,
-                              time.perf_counter() - restore_s)
+        # failover restores exactly as it sees prefill-pool adoptions;
+        # the hint's trace context (frozen at failover) parents the
+        # span under the gateway's replayed-frame root
+        hint_context = hint.get("trace_context")
+        self._note_adopt_span(
+            stream, key, time.perf_counter() - restore_s,
+            parent=(hint_context
+                    if isinstance(hint_context, dict) else None))
 
-    def _note_adopt_span(self, stream, key, elapsed_s: float) -> None:
+    def _note_adopt_span(self, stream, key, elapsed_s: float,
+                         parent: dict | None = None) -> None:
         """Record the adopt (KV-migration) span on the frame trace so
         `aiko tune` can attribute migration-bound waits distinctly from
-        slot-queue waits."""
+        slot-queue waits.  `parent` is the upstream (prefill-hop) trace
+        context the handoff descriptor carried, linking the adopt span
+        across processes in a merged fleet artifact."""
         telemetry = getattr(self.pipeline, "telemetry", None)
         if telemetry is None or not telemetry.enabled:
             return
         telemetry.record_adopt(
             self.pipeline.streams.get(key[0]), key[1],
-            self.definition.name, elapsed_s)
+            self.definition.name, elapsed_s, parent=parent)
 
     def _schedule_pump(self):
         """At most ONE pump message in flight: each tick runs one fused

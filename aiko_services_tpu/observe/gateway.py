@@ -9,17 +9,32 @@
 # failover (replica deaths, streams migrated).  The admitted-latency
 # histogram measures submit -> response through the whole tier -- the
 # number an SLO is written against.
+#
+# Fleet tracing: the gateway is the ROOT-SPAN OWNER of every admitted
+# frame's distributed trace.  `frame_begin` mints the trace id, the
+# gateway's own spans (admit-wait, route decision, shed/throttle,
+# failover replay -- see the taxonomy in observe/trace.py) accumulate
+# on it, and the propagated context rides the frame data to every
+# replica so their spans continue the SAME trace.  `export_trace` /
+# `chrome_events` / `trace_metadata` mirror PipelineTelemetry's
+# surface, so bench.py harvests a gateway exactly like a pipeline and
+# `aiko trace merge` joins both on one timeline.
 
 from __future__ import annotations
 
+
 from ..utils import get_logger
 from .metrics import MetricsRegistry
+from .trace import Tracer, now_us, to_us, trace_metadata
 
 __all__ = ["GatewayTelemetry"]
 
 _LOGGER = get_logger("gateway_telemetry")
 
 DEFAULT_METRICS_INTERVAL = 10.0
+# per-stream end-to-end decomposition entries kept in the summary: the
+# EC share is a compact view, not a database (totals always ride)
+DECOMPOSITION_STREAM_CAP = 32
 
 
 class GatewayTelemetry:
@@ -28,6 +43,15 @@ class GatewayTelemetry:
         self.gateway = gateway
         self.enabled = enabled
         self.registry = MetricsRegistry()
+        self.tracer = Tracer()
+        # per-stream end-to-end decomposition accumulators (seconds):
+        # admit + route + queue + prefill + decode + emit -- where each
+        # admitted stream's latency went, published in the summary/EC
+        # share and rendered by the dashboard gateway plugin.  A
+        # destroyed stream's stages fold into the persistent fleet
+        # total, so the aggregate survives stream churn
+        self._decomposition: dict[str, dict] = {}
+        self._decomposition_total: dict[str, float] = {}
         registry = self.registry
         self.admitted = registry.counter("gateway.admitted")
         self.shed_streams = registry.counter("gateway.shed_streams")
@@ -83,6 +107,167 @@ class GatewayTelemetry:
         if self.enabled and interval > 0:
             self._timer = self._publish_snapshot
             gateway.process.event.add_timer_handler(self._timer, interval)
+
+    # -- fleet tracing: gateway root spans ---------------------------------
+
+    def frame_begin(self, stream_id: str, frame_id: int):
+        """Mint the ROOT trace for one admitted frame (the gateway owns
+        the fleet-wide trace id); returns None with telemetry off, so
+        the wire payload then carries no trace-context bytes at all."""
+        if not self.enabled:
+            return None
+        return self.tracer.begin(stream_id, frame_id)
+
+    def frame_done(self, trace, status: str = "ok") -> None:
+        if trace is not None:
+            self.tracer.finish(trace, status=status)
+
+    def record_route(self, trace, start_s: float, replica_name: str,
+                     pool: str = "decode") -> None:
+        """The placement decision for one dispatched frame."""
+        if trace is not None:
+            trace.span("route:gateway", "gateway", to_us(start_s),
+                       {"replica": replica_name, "pool": pool})
+
+    def record_admit_wait(self, trace) -> float:
+        """Admit-wait: frame submit -> FIRST replica dispatch.  Covers
+        the parked-queue wait (zero-ish for an immediately dispatchable
+        frame); THE span the admission-bound floor classifies on.
+        Returns the elapsed seconds for the queue-stage decomposition."""
+        if trace is None:
+            return 0.0
+        elapsed_us = now_us() - trace.start_us
+        trace.span("admit:gateway", "gateway", trace.start_us)
+        return elapsed_us / 1e6
+
+    def record_shed_span(self, trace, reason: str) -> None:
+        if trace is not None:
+            trace.instant("shed:gateway", "gateway", {"reason": reason})
+
+    def record_shed_stream(self, stream_id: str, reason: str) -> None:
+        """A whole STREAM was shed at admission (no frame trace exists
+        yet): a global gateway-lane instant."""
+        if self.enabled:
+            self.tracer.instant_global(
+                "shed:gateway", "gateway",
+                {"stream": stream_id, "reason": reason})
+
+    def record_throttle_span(self, rate: float) -> None:
+        if self.enabled:
+            self.tracer.instant_global("throttle:gateway", "gateway",
+                                       {"rate": rate})
+
+    def record_replay(self, elapsed_s: float, streams: int,
+                      frames: int, paced: bool = False,
+                      paced_streams: int = 0,
+                      paced_frames: int = 0) -> None:
+        """One failover/drain migration wave (_migrate_streams), or a
+        deferred paced-recovery wave: a global gateway-lane span so
+        recovery storms are visible on the merged fleet timeline.
+        `streams`/`frames` count what THIS wave replayed;
+        `paced_streams`/`paced_frames` count what it re-pinned but
+        deferred to scheduled `paced_replay:` waves."""
+        if self.enabled:
+            name = "paced_replay:gateway" if paced else "replay:gateway"
+            args = {"streams": streams, "frames": frames}
+            if paced_streams:
+                args["paced_streams"] = paced_streams
+                args["paced_frames"] = paced_frames
+            self.tracer.span_global(name, "gateway", elapsed_s, args)
+
+    # -- per-stream end-to-end decomposition -------------------------------
+
+    def record_stage(self, stream_id: str, stage: str,
+                     elapsed_s: float) -> None:
+        """Accumulate one stage's share of a stream's end-to-end
+        latency.  Stages: admit (admission processing), route
+        (placement decisions), queue (parked wait), prefill (disagg
+        hop 1), decode (pinned-replica service), emit (response
+        delivery)."""
+        if not self.enabled:
+            return
+        stages = self._decomposition.get(stream_id)
+        if stages is None:
+            if len(self._decomposition) >= DECOMPOSITION_STREAM_CAP:
+                # the map is a compact view, not a database: past the
+                # cap a stream's stages fold straight into the
+                # persistent fleet total (same place destroyed streams
+                # land), keeping memory and publish cost bounded at
+                # 10k-stream scale
+                self._decomposition_total[stage] = (
+                    self._decomposition_total.get(stage, 0.0)
+                    + elapsed_s)
+                return
+            stages = self._decomposition[stream_id] = {}
+        stages[stage] = stages.get(stage, 0.0) + elapsed_s
+
+    def forget_stream(self, stream_id: str) -> None:
+        stages = self._decomposition.pop(stream_id, None)
+        if stages:
+            for stage, seconds in stages.items():
+                self._decomposition_total[stage] = (
+                    self._decomposition_total.get(stage, 0.0) + seconds)
+
+    def stream_decomposition(self) -> dict:
+        """Per-LIVE-stream decomposition in ms (bounded by
+        DECOMPOSITION_STREAM_CAP; overflow streams accumulate straight
+        into the total) plus the fleet `_total` aggregate (destroyed
+        streams included) -- where every admitted stream's latency
+        went, end to end."""
+        totals = dict(self._decomposition_total)
+        rendered = {}
+        for stream_id in sorted(self._decomposition):
+            stages = self._decomposition[stream_id]
+            for stage, seconds in stages.items():
+                totals[stage] = totals.get(stage, 0.0) + seconds
+            rendered[stream_id] = {
+                stage: round(seconds * 1e3, 3)
+                for stage, seconds in sorted(stages.items())}
+        rendered["_total"] = {stage: round(seconds * 1e3, 3)
+                              for stage, seconds in sorted(
+                                  totals.items())}
+        return rendered
+
+    # -- per-priority SLO attainment ---------------------------------------
+
+    def record_slo(self, priority: int, within: bool) -> None:
+        """One completed frame of an SLO-carrying stream judged against
+        its declared slo_ms: per-priority-bucket attainment/burn
+        counters (the numbers ROADMAP #4's per-tenant accounting
+        reads)."""
+        if not self.enabled:
+            return
+        if within:
+            self.registry.counter(f"gateway.slo_ok:p{priority}").inc()
+        else:
+            self.registry.counter(
+                f"gateway.slo_miss:p{priority}").inc()
+
+    def slo_summary(self) -> dict:
+        """Per-priority {ok, miss, attainment, burn}: attainment is the
+        in-SLO fraction, burn the complement (the error-budget burn
+        fraction)."""
+        buckets: dict[str, dict] = {}
+        snapshot = self.registry.snapshot()
+        for name, value in (snapshot.get("counters") or {}).items():
+            for kind, prefix in (("ok", "gateway.slo_ok:p"),
+                                 ("miss", "gateway.slo_miss:p")):
+                if name.startswith(prefix):
+                    priority = name[len(prefix):]
+                    buckets.setdefault(priority, {"ok": 0, "miss": 0})[
+                        kind] = int(value)
+        for record in buckets.values():
+            judged = record["ok"] + record["miss"]
+            record["attainment"] = round(
+                record["ok"] / judged, 4) if judged else None
+            record["burn"] = round(
+                record["miss"] / judged, 4) if judged else None
+        # numeric priority order (p2 before p10), odd keys last
+        return dict(sorted(
+            buckets.items(),
+            key=lambda item: (not item[0].isdigit(),
+                              int(item[0]) if item[0].isdigit() else 0,
+                              item[0])))
 
     def record_queue_depths(self, depths: dict) -> None:
         """Parked-queue occupancy PER PRIORITY (gauge family
@@ -144,6 +329,15 @@ class GatewayTelemetry:
             summary["prefill_fallbacks"] = self.prefill_fallbacks.value
         if self.recovery_paced.value:
             summary["recovery_paced"] = self.recovery_paced.value
+        slo = self.slo_summary()
+        if slo:
+            # per-priority SLO attainment/burn (the per-tenant
+            # accounting surface): only streams that DECLARED slo_ms
+            # are judged, so the key is absent on SLO-less fleets
+            summary["slo"] = slo
+        if self._decomposition or self._decomposition_total:
+            summary["stream_decomposition"] = (
+                self.stream_decomposition())
         if self.latency.count:
             summary["admit_latency_p50_ms"] = round(
                 self.latency.quantile(0.5) * 1000, 3)
@@ -183,6 +377,33 @@ class GatewayTelemetry:
                 gateway.ec_producer.update("metrics", self.summary())
         except Exception as error:  # export must never kill the gateway
             _LOGGER.warning("gateway metrics publish failed: %s", error)
+
+    # -- trace export (PipelineTelemetry-compatible surface) ---------------
+
+    def chrome_events(self) -> list:
+        return self.tracer.chrome_events(
+            process_name=f"gateway:{self.gateway.name}")
+
+    def trace_metadata(self, config: dict | None = None,
+                       config_name: str | None = None) -> dict:
+        """Self-describing metadata for the gateway's trace artifact:
+        no pipeline definition (a gateway runs no graph), but the
+        metrics snapshot, the tracer pid, and -- like every process --
+        the clock epoch the fleet merger aligns with."""
+        metadata = trace_metadata(config=config,
+                                  config_name=config_name,
+                                  metrics=self.snapshot(),
+                                  clock_epoch=True)
+        metadata["pids"] = [self.tracer._pid]
+        metadata["role"] = "gateway"
+        return metadata
+
+    def export_trace(self, path: str, config: dict | None = None,
+                     config_name: str | None = None) -> int:
+        return self.tracer.export(
+            path, process_name=f"gateway:{self.gateway.name}",
+            metadata=self.trace_metadata(config=config,
+                                         config_name=config_name))
 
     def stop(self) -> None:
         if self._timer is not None:

@@ -13,6 +13,9 @@
 # MQTT client write into) on `{topic_path}/metrics` -- matched by the
 # Recorder's `{namespace}/+/+/+/metrics` subscription -- and mirrors a
 # compact summary into the pipeline's EC share for dashboards.
+#
+# Span names/categories and the time_queue_* vs time_* key split
+# follow THE taxonomy documented once in observe/trace.py.
 
 from __future__ import annotations
 
@@ -90,10 +93,16 @@ class PipelineTelemetry:
 
     # -- frame lifecycle ---------------------------------------------------
 
-    def frame_begin(self, stream, frame) -> None:
+    def frame_begin(self, stream, frame, context: dict | None = None
+                    ) -> None:
         if not self.enabled:
             return
         frame.trace = self.tracer.begin(stream.stream_id, frame.frame_id)
+        if context is not None:
+            # cross-process continuation: the gateway (or another
+            # upstream hop) minted this trace -- keep its id, parent
+            # our frame span under its span id
+            frame.trace.adopt(context)
 
     def frame_end(self, stream, frame, dropped: bool = False,
                   error: bool = False) -> None:
@@ -257,11 +266,14 @@ class PipelineTelemetry:
                   "tokens": stats.get("tokens")}))
 
     def record_adopt(self, stream, frame_id, node: str,
-                     elapsed_s: float) -> None:
+                     elapsed_s: float,
+                     parent: dict | None = None) -> None:
         """A disaggregated decode element adopted a frame's migrated
         KV blocks (fetch + pool scatter): its own span category so
         `aiko tune` classifies migration-bound elements distinctly
-        from queue-bound ones."""
+        from queue-bound ones.  `parent` is the prefill hop's trace
+        context (it rode the handoff descriptor), recorded as the
+        span's cross-process parent link."""
         if not self.enabled:
             return
         self.registry.histogram("adopt_s:" + node).record(elapsed_s)
@@ -269,9 +281,12 @@ class PipelineTelemetry:
                  if stream is not None else None)
         trace = frame.trace if frame is not None else None
         if trace is not None:
+            args = None
+            if parent and parent.get("span_id"):
+                args = {"parent": str(parent["span_id"])}
             trace.events.append(
                 ("X", f"adopt:{node}", "engine",
-                 now_us() - elapsed_s * 1e6, elapsed_s * 1e6, None))
+                 now_us() - elapsed_s * 1e6, elapsed_s * 1e6, args))
 
     def record_checkpoint(self, node: str, elapsed_s: float,
                           checkpoint_bytes: int) -> None:
@@ -590,7 +605,7 @@ class PipelineTelemetry:
             definition_document=definition_to_document(
                 self.pipeline.definition),
             config=config, config_name=config_name,
-            metrics=self.snapshot())
+            metrics=self.snapshot(), clock_epoch=True)
         # this tracer's synthetic pid: when several pipelines' events
         # share one artifact (bench combined file, router replicas),
         # the tune loader filters spans to the selected run's pids

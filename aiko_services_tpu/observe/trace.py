@@ -10,6 +10,56 @@
 # and one thread lane per stream), which chrome://tracing and Perfetto
 # both load directly.
 #
+# SPAN TAXONOMY -- the one canonical reference (telemetry.py, the
+# serving gateway, and tune/loader.py all follow this table):
+#
+#   category   span names                    meaning
+#   --------   ---------------------------   ---------------------------
+#   frame      "frame {id}"                  one frame's whole lifetime
+#                                            in ONE process (per-process
+#                                            root; carries span_id and,
+#                                            on a propagated trace, the
+#                                            upstream parent span id)
+#   element    "{node}"                      one element call; args.path
+#                                            = inline|fused|chained|
+#                                            async|remote
+#   queue      "queue:{node}"                scheduler-induced wait
+#                                            (micro-batch park -> flush,
+#                                            or engine slot wait when
+#                                            row-suffixed "queue:lm[3]")
+#   engine     "prefill:{node}",             continuous-batching engine
+#              "decode_steps:{node}",        phases; "adopt:" = KV
+#              "adopt:{node}",               migration (disagg or warm
+#              "checkpoint:{node}"           restore), "checkpoint:" =
+#                                            snapshot shipping (global
+#                                            lane: covers every slot)
+#   gateway    "admit:gateway",              serving-tier spans: admit =
+#              "route:gateway",              frame submit -> replica
+#              "replay:gateway",             dispatch (parked/admission
+#              "shed:gateway",               wait), route = placement
+#              "throttle:gateway",           decision, replay = failover
+#              "paced_replay:gateway"        _migrate_streams wave,
+#                                            paced_replay = deferred
+#                                            recovery wave; shed/
+#                                            throttle are instants
+#   compile    "compile:{node}"              (re)compilation instants
+#   park/fault instants                      park/resume, retries,
+#                                            deadline + breaker events
+#
+# Naming scheme: "{kind}:{node}" -- tune/loader._node_of strips the
+# prefix (and the "[row]" suffix) to join spans to typed graph nodes.
+# The matching frame.metrics keys split the SAME way on every dispatch
+# path: `time_{node}` is element/device compute, `time_queue_{node}` is
+# scheduler wait (micro-batch fill, engine slot wait) -- never mixed.
+#
+# Cross-process propagation: a TRACE CONTEXT ({trace_id, span_id}) rides
+# frame data under TRACE_CONTEXT_KEY.  The serving gateway mints the
+# trace at admission (root-span owner); every downstream process pops
+# the context at stream ingress and CONTINUES the same trace -- its
+# frame span carries the propagated trace_id plus parent = the upstream
+# span id, so a merged artifact (observe/collector.py) nests gateway ->
+# replica -> prefill/keeper spans on one timeline.
+#
 # Cost contract: when tracing is disabled the frame carries trace=None
 # and every hook is a single `is None` check; when enabled, a span is one
 # perf_counter read and one tuple append -- no dict churn on the hot
@@ -24,9 +74,11 @@ import os
 import time
 from collections import deque
 
-__all__ = ["FrameTrace", "Tracer", "chrome_trace_document",
-           "definition_fingerprint", "trace_metadata",
-           "trace_metadata_of"]
+__all__ = ["FrameTrace", "Tracer", "TRACE_CONTEXT_KEY",
+           "attach_trace_context", "chrome_trace_document",
+           "clock_epoch_unix_us", "definition_fingerprint",
+           "make_trace_context", "pop_trace_context", "trace_context_of",
+           "trace_metadata", "trace_metadata_of"]
 
 # trace-metadata schema version: bumped when the embedded layout
 # changes; the tune/ loader refuses versions it does not understand
@@ -48,6 +100,55 @@ def to_us(perf_counter_s: float) -> float:
     return (perf_counter_s - _EPOCH) * 1e6
 
 
+def clock_epoch_unix_us() -> float:
+    """Wall-clock microseconds (Unix epoch) at THIS process's trace
+    timestamp 0.  Every export stamps it into trace_metadata so the
+    fleet merger (observe/collector.py) can shift per-process
+    timestamps onto one shared timeline: two processes whose spans are
+    concurrent in wall time stay concurrent in the merged artifact,
+    regardless of when each process booted."""
+    return time.time() * 1e6 - now_us()
+
+
+# reserved frame-data key the cross-process trace context rides under:
+# popped at stream ingress (never reaches element inputs), absent
+# entirely when the sender's telemetry is disabled -- the wire payload
+# is then byte-identical to an untraced build's
+TRACE_CONTEXT_KEY = "_trace_context"
+
+
+def make_trace_context(trace: "FrameTrace") -> dict:
+    """The propagable identity of one frame trace: the (possibly
+    already-propagated) trace id plus THIS process's frame span id as
+    the downstream parent."""
+    return {"trace_id": trace.trace_id, "span_id": trace.span_id}
+
+
+def trace_context_of(frame_data) -> dict | None:
+    """Read (without removing) the trace context riding `frame_data`."""
+    if not isinstance(frame_data, dict):
+        return None
+    context = frame_data.get(TRACE_CONTEXT_KEY)
+    return context if isinstance(context, dict) else None
+
+
+def attach_trace_context(frame_data: dict, context: dict) -> dict:
+    """A COPY of `frame_data` carrying `context` -- the original stays
+    untouched so failover replay / byte-compare semantics hold."""
+    merged = dict(frame_data)
+    merged[TRACE_CONTEXT_KEY] = context
+    return merged
+
+
+def pop_trace_context(frame_data) -> dict | None:
+    """Remove and return the trace context (stream-ingress side): the
+    context must never leak into element inputs or outputs."""
+    if not isinstance(frame_data, dict):
+        return None
+    context = frame_data.pop(TRACE_CONTEXT_KEY, None)
+    return context if isinstance(context, dict) else None
+
+
 class FrameTrace:
     """Span accumulator for ONE frame: rides Frame.trace through the
     graph.  `marks` holds open interval starts (queue parks) keyed by
@@ -57,7 +158,8 @@ class FrameTrace:
     start/end/status, keeping the per-frame hot path to appends."""
 
     __slots__ = ("pid", "seq", "stream_id", "frame_id", "start_us",
-                 "end_us", "status", "events", "marks")
+                 "end_us", "status", "events", "marks",
+                 "origin_trace_id", "parent_span_id")
 
     def __init__(self, pid: int, seq: int, stream_id: str,
                  frame_id: int):
@@ -70,11 +172,38 @@ class FrameTrace:
         self.status = "ok"
         self.events: list = []
         self.marks: dict | None = None  # lazily built on first park
+        # cross-process propagation (see TRACE_CONTEXT_KEY): when an
+        # upstream process (the serving gateway) minted the trace, this
+        # frame CONTINUES it -- same trace id, parented to the
+        # upstream frame span
+        self.origin_trace_id: str | None = None
+        self.parent_span_id: str | None = None
 
     @property
     def trace_id(self) -> str:
         # formatted on demand: minting a frame costs no string build
+        if self.origin_trace_id is not None:
+            return self.origin_trace_id
         return f"{self.pid:x}-{self.seq:x}"
+
+    @property
+    def span_id(self) -> str:
+        """This frame span's own identity -- what downstream processes
+        record as their parent.  (pid, seq) is unique per tracer and
+        pids are synthetic-per-process, so ids survive a fleet merge."""
+        return f"{self.pid:x}.{self.seq:x}"
+
+    def adopt(self, context: dict | None) -> None:
+        """Continue a propagated trace: keep the upstream trace id and
+        parent this process's frame span under the upstream span."""
+        if not context:
+            return
+        trace_id = context.get("trace_id")
+        if trace_id:
+            self.origin_trace_id = str(trace_id)
+        parent = context.get("span_id")
+        if parent:
+            self.parent_span_id = str(parent)
 
     def span(self, name: str, category: str, start_us: float,
              args: dict | None = None) -> None:
@@ -181,11 +310,17 @@ class Tracer:
                      "args": {"name": f"stream {trace.stream_id}"}})
             end_us = (trace.end_us if trace.end_us is not None
                       else now_us())
+            frame_args = {"trace_id": trace.trace_id,
+                          "span_id": trace.span_id,
+                          "status": trace.status,
+                          "stream": trace.stream_id}
+            if trace.parent_span_id is not None:
+                # propagated trace: this process's frame span nests
+                # under the upstream (gateway) span in a merged artifact
+                frame_args["parent"] = trace.parent_span_id
             events.append(self._event(
                 "X", f"frame {trace.frame_id}", "frame", trace.start_us,
-                end_us - trace.start_us,
-                {"trace_id": trace.trace_id, "status": trace.status,
-                 "stream": trace.stream_id}, tid=lane))
+                end_us - trace.start_us, frame_args, tid=lane))
             for kind, name, category, ts, dur, args in trace.events:
                 merged = {"trace_id": trace.trace_id,
                           "frame_id": trace.frame_id}
@@ -245,12 +380,22 @@ def definition_fingerprint(document: dict) -> str:
 def trace_metadata(definition_document: dict | None = None,
                    config: dict | None = None,
                    config_name: str | None = None,
-                   metrics: dict | None = None) -> dict:
+                   metrics: dict | None = None,
+                   clock_epoch: bool = False) -> dict:
     """Assemble the self-describing metadata block one trace artifact
     carries: the pipeline definition it was recorded under (with its
     fingerprint), the bench config block that produced it, and a
-    metrics-registry snapshot taken at export."""
+    metrics-registry snapshot taken at export.
+
+    `clock_epoch=True` additionally stamps this process's
+    clock_epoch_unix_us (what the fleet merger aligns timestamps
+    with).  LIVE exporters (PipelineTelemetry / GatewayTelemetry) pass
+    it; synthesized fixtures must not -- the stamp is wall-clock
+    dependent and would break their byte-deterministic regeneration."""
     metadata: dict = {"schema": TRACE_METADATA_SCHEMA}
+    if clock_epoch:
+        metadata["clock_epoch_unix_us"] = round(
+            clock_epoch_unix_us(), 3)
     if definition_document is not None:
         metadata["definition"] = definition_document
         metadata["fingerprint"] = definition_fingerprint(

@@ -28,6 +28,7 @@ from collections import deque
 
 from ..faults import create_injector, get_injector
 from ..observe import PipelineTelemetry
+from ..observe.trace import pop_trace_context
 from ..runtime import Actor, Lease, ServiceFilter, ServicesCache
 from ..runtime.service import SERVICE_PROTOCOL_PIPELINE
 from ..utils import (
@@ -570,11 +571,16 @@ class Pipeline(Actor):
             stream.topic_response = topic_response
         if not stream_dict.get("_local"):
             stream.pending += 1
+        # a propagated trace context (serving gateway = root-span
+        # owner) rides the frame data under a reserved key: pop it at
+        # ingress so it NEVER reaches element inputs, then continue the
+        # upstream trace instead of minting a fresh id
+        trace_context = pop_trace_context(frame_data)
         frame = Frame(frame_id=frame_id, swag=dict(frame_data))
         stream.frames[frame_id] = frame
         # stream ingress: mint the frame's trace id (spans accumulate on
         # the frame as it moves through the graph)
-        self.telemetry.frame_begin(stream, frame)
+        self.telemetry.frame_begin(stream, frame, context=trace_context)
         # frame deadline: bounds the WHOLE graph walk including parked
         # remote/async branches -- a dead RemoteElement or lost reply
         # releases the frame (dead-lettered) instead of leaking it until
@@ -1948,6 +1954,16 @@ class Pipeline(Actor):
                 len(entries) for entries in self._micro_pending.values()),
             "streams": len(self.streams),
         }
+
+    def publish_trace(self, topic_response) -> None:
+        """Wire query (`aiko trace collect`): publish this pipeline's
+        self-describing Perfetto document -- the live-fleet harvest
+        path, mirroring the Recorder's paged dead-letter query.  The
+        reply shape lives in observe/collector.py (shared with the
+        gateway)."""
+        from ..observe import publish_trace_document
+        publish_trace_document(self.process, self.telemetry,
+                               self.topic_path, topic_response)
 
     def throttle(self, stream_id, rate) -> None:
         """Wire-invocable backpressure: cap `stream_id`'s frame

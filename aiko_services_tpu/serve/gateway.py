@@ -49,6 +49,7 @@ import time
 
 from ..faults import create_injector, get_injector
 from ..observe import GatewayTelemetry
+from ..observe.trace import attach_trace_context, make_trace_context
 from ..pipeline.pipeline import DEFAULT_GRACE_TIME
 from ..pipeline.tensors import decode_frame_data, encode_frame_data
 from ..runtime import Actor, Lease, RetainedElection, ServiceFilter
@@ -190,7 +191,8 @@ class _GatewayStream:
                  "grace_time", "replica", "queue_response",
                  "topic_response", "throttle", "inflight", "delivered",
                  "delivered_floor", "cursor", "parked", "throttled",
-                 "lease", "prefill_created", "keeper")
+                 "lease", "prefill_created", "keeper", "traces",
+                 "dispatch_s")
 
     def __init__(self, stream_id: str, priority: int, slo_ms: float,
                  parameters: dict, grace_time: float, replica: _Replica,
@@ -224,6 +226,12 @@ class _GatewayStream:
         # the gateway policy's keeper, or the journaled one after a
         # takeover -- "checkpoint locations ride the gateway journal"
         self.keeper: str | None = None
+        # fleet tracing (telemetry-gated; both stay empty with
+        # telemetry off): the gateway-owned ROOT trace per in-flight
+        # frame, and each frame's first-dispatch perf_counter stamp
+        # (admit-wait span boundary + decode-stage decomposition)
+        self.traces: dict[int, object] = {}
+        self.dispatch_s: dict[int, float] = {}
 
     def is_delivered(self, frame_id: int) -> bool:
         return (frame_id <= self.delivered_floor
@@ -863,6 +871,8 @@ class Gateway(Actor):
         per tick by the replicas' chunked prefill) trickles in."""
         for stream_id in list(replica.streams):
             self._send_destroy(replica, stream_id)
+        replay_start = time.perf_counter()
+        replayed_frames = 0
         now = time.monotonic()
         # pacing protects survivors from a CRASH recovery storm; a
         # graceful drain migrates at full speed (nothing crashed, the
@@ -873,6 +883,8 @@ class Gateway(Actor):
                 and not replica.draining else 0.0)
         immediate = max(int(rate), 1)
         migrated = 0
+        paced_streams = 0
+        paced_frames = 0
         for stream_id in list(replica.streams):
             replica.streams.discard(stream_id)
             stream = self.streams.get(stream_id)
@@ -931,11 +943,25 @@ class Gateway(Actor):
                 self._paced_frames[stream_id] = {"ids": replay_ids,
                                                  "hint": hint}
                 self.telemetry.recovery_paced.inc()
+                paced_streams += 1
+                paced_frames += len(replay_ids)
                 self.post_message_later(
                     "_paced_replay", [stream_id],
                     (migrated - immediate) / rate)
                 continue
+            replayed_frames += len(replay_ids)
             self._replay_frames(stream, replay_ids, hint)
+        if migrated:
+            # failover replay wave on the merged fleet timeline: how
+            # long re-pinning + replaying this replica's streams took
+            # (paced streams were re-pinned here but replay in their
+            # own scheduled paced_replay: waves)
+            self.telemetry.record_replay(
+                time.perf_counter() - replay_start,
+                streams=migrated - paced_streams,
+                frames=replayed_frames,
+                paced_streams=paced_streams,
+                paced_frames=paced_frames)
 
     def _restore_hint(self, stream: _GatewayStream,
                       dead: _Replica) -> dict | None:
@@ -967,7 +993,16 @@ class Gateway(Actor):
                 data = None
                 if hint is not None:
                     data = dict(entry[0])
-                    data["restore"] = dict(hint)
+                    restore = dict(hint)
+                    trace = stream.traces.get(frame_id)
+                    if trace is not None:
+                        # the restore HINT carries the trace context
+                        # too: the survivor's warm restore parents
+                        # under the frame's gateway root even though
+                        # the hint was frozen at failover time
+                        restore["trace_context"] = make_trace_context(
+                            trace)
+                    data["restore"] = restore
                 self._send_frame(target, stream, frame_id, entry,
                                  data=data)
             else:
@@ -989,7 +1024,11 @@ class Gateway(Actor):
             return
         if stream.replica is None:
             return
+        paced_start = time.perf_counter()
         self._replay_frames(stream, pending["ids"], pending["hint"])
+        self.telemetry.record_replay(
+            time.perf_counter() - paced_start, streams=1,
+            frames=len(pending["ids"]), paced=True)
 
     # -- placement ---------------------------------------------------------
 
@@ -1055,6 +1094,7 @@ class Gateway(Actor):
                       grace_time=DEFAULT_GRACE_TIME, topic_response=None,
                       queue_response=None, throttle=None) -> None:
         stream_id = str(stream_id)
+        admit_start = time.perf_counter()
         try:
             if isinstance(parameters, str):   # wire call: JSON-encoded
                 parameters = json.loads(parameters) if parameters else {}
@@ -1110,6 +1150,10 @@ class Gateway(Actor):
         self.streams[stream_id] = stream
         replica.streams.add(stream_id)
         self.telemetry.admitted.inc()
+        # decomposition: admission processing (bucket take + placement)
+        # is the stream's one-time `admit` share
+        self.telemetry.record_stage(
+            stream_id, "admit", time.perf_counter() - admit_start)
         self._mark_journal(stream)
         self._send_create(replica, stream)
         if self._throttle_on:
@@ -1134,6 +1178,7 @@ class Gateway(Actor):
         """Typed shed: the caller learns WHY, immediately -- never
         silent queue growth (Clockwork-style admission)."""
         self.telemetry.shed_streams.inc()
+        self.telemetry.record_shed_stream(stream_id, reason)
         _LOGGER.info("%s: stream %s shed (%s)", self.name, stream_id,
                      reason)
         if topic_response:
@@ -1181,6 +1226,13 @@ class Gateway(Actor):
         seq = self._seq = self._seq + 1
         entry = [frame_data or {}, time.monotonic(), seq]
         stream.inflight[frame_id] = entry
+        # root-span ownership: the gateway mints the frame's fleet-wide
+        # trace here, at admission -- every replica that later serves
+        # this frame CONTINUES the same trace (context rides the wire
+        # in _send_frame).  None with telemetry off: zero trace bytes
+        trace = self.telemetry.frame_begin(stream_id, frame_id)
+        if trace is not None:
+            stream.traces[frame_id] = trace
         self._mark_journal(stream)
         replica = stream.replica
         dispatchable = (replica is not None
@@ -1254,6 +1306,13 @@ class Gateway(Actor):
             prefill = self.replicas.get(topic_path)
             if prefill is not None:
                 self._send_destroy(prefill, stream_id)
+        for trace in stream.traces.values():
+            # frames still open at destroy: finish their root spans so
+            # the admission wait they DID accrue still exports
+            self.telemetry.frame_done(trace, status="destroyed")
+        stream.traces.clear()
+        stream.dispatch_s.clear()
+        self.telemetry.forget_stream(stream_id)
         stream.inflight.clear()
         self._journal_forget(stream_id)
         self._update_share()
@@ -1312,12 +1371,35 @@ class Gateway(Actor):
             self.post_message("_replica_lost", [
                 replica.topic_path, "injected replica_kill"])
             return
+        route_start = time.perf_counter()
         replica.outstanding += 1
         replica.routed += 1
         replica.note_load(time.monotonic(), self.policy)
         self.telemetry.routed.inc()
         self.telemetry.record_replica_routed(replica.name)
         payload = entry[0] if data is None else data
+        trace = stream.traces.get(frame_id)
+        if trace is not None:
+            if frame_id not in stream.dispatch_s:
+                # FIRST dispatch closes the admit-wait span (submit ->
+                # dispatch, parked wait included); re-dispatches (disagg
+                # hop 2, failover replay) extend the same trace without
+                # a second admission
+                wait_s = self.telemetry.record_admit_wait(trace)
+                self.telemetry.record_stage(stream.stream_id, "queue",
+                                            wait_s)
+            stream.dispatch_s[frame_id] = route_start
+            self.telemetry.record_route(trace, route_start,
+                                        replica.name,
+                                        pool=replica.pool_role())
+            self.telemetry.record_stage(
+                stream.stream_id, "route",
+                time.perf_counter() - route_start)
+            # propagation: the trace context rides the frame data (a
+            # COPY -- entry[0] stays pristine for replay byte-equality)
+            # so the replica continues the gateway's trace
+            payload = attach_trace_context(payload,
+                                           make_trace_context(trace))
         if replica.pipeline is not None:
             replica.pipeline.post_message("process_frame", [
                 {"stream_id": stream.stream_id, "frame_id": frame_id},
@@ -1359,6 +1441,15 @@ class Gateway(Actor):
     def _shed_frame(self, stream: _GatewayStream, frame_id: int,
                     reason: str) -> None:
         stream.inflight.pop(frame_id, None)
+        stream.dispatch_s.pop(frame_id, None)
+        trace = stream.traces.pop(frame_id, None)
+        if trace is not None:
+            self.telemetry.record_shed_span(trace, reason)
+            self.telemetry.frame_done(trace, status="shed")
+        else:
+            # pre-admission sheds (SLO estimate) fire before the frame
+            # trace exists: a global gateway-lane instant instead
+            self.telemetry.record_shed_stream(stream.stream_id, reason)
         self.telemetry.shed_frames.inc()
         if stream.topic_response:
             self.process.publish(
@@ -1447,6 +1538,7 @@ class Gateway(Actor):
             self._signal_throttle(0.0)
 
     def _signal_throttle(self, rate: float) -> None:
+        self.telemetry.record_throttle_span(rate)
         counter = (self.telemetry.throttled if rate > 0
                    else self.telemetry.unthrottled)
         for stream in self.streams.values():
@@ -1536,6 +1628,16 @@ class Gateway(Actor):
         if entry is None or stream.is_delivered(frame_id):
             self.telemetry.duplicates.inc()
             return
+        trace = stream.traces.pop(frame_id, None)
+        dispatched_s = stream.dispatch_s.pop(frame_id, None)
+        if dispatched_s is not None:
+            # decomposition: pinned-replica service time (dispatch ->
+            # response) is the stream's `decode` share -- the prefill
+            # hop's share was credited by _prefill_done
+            self.telemetry.record_stage(
+                stream.stream_id, "decode",
+                time.perf_counter() - dispatched_s)
+        emit_start = (time.perf_counter() if trace is not None else 0.0)
         stream.delivered.add(frame_id)
         # collapse the contiguous delivered prefix into the floor: the
         # dedupe state a long-lived stream keeps is one int + the
@@ -1562,6 +1664,12 @@ class Gateway(Actor):
         else:
             self.telemetry.completed.inc()
             self.telemetry.latency.record(now - entry[1])
+            if stream.slo_ms > 0:
+                # per-priority SLO attainment: completed frames judged
+                # against the stream's declared end-to-end budget
+                self.telemetry.record_slo(
+                    stream.priority,
+                    (now - entry[1]) * 1000.0 <= stream.slo_ms)
             self._completions.append(now)
             if len(self._completions) > _RATE_WINDOW:
                 del self._completions[:len(self._completions)
@@ -1583,6 +1691,11 @@ class Gateway(Actor):
                     generate("process_frame_response", [
                         reply,
                         encode_frame_data(outputs).encode("ascii")]))
+        if trace is not None:
+            self.telemetry.record_stage(
+                stream.stream_id, "emit",
+                time.perf_counter() - emit_start)
+            self.telemetry.frame_done(trace, status=status)
         self._drain_parked()
 
     def _prefill_done(self, stream: _GatewayStream, frame_id: int,
@@ -1595,6 +1708,13 @@ class Gateway(Actor):
         prefills locally, the stream never notices."""
         stage_topic = entry[3][1]
         del entry[3:]               # back to the plain replay shape
+        dispatched_s = stream.dispatch_s.get(frame_id)
+        if dispatched_s is not None:
+            # decomposition: the disagg hop-1 share (dispatch ->
+            # prefill response); hop 2's dispatch re-stamps below
+            self.telemetry.record_stage(
+                stream.stream_id, "prefill",
+                time.perf_counter() - dispatched_s)
         prefill = self.replicas.get(stage_topic)
         if prefill is not None:
             prefill.outstanding = max(0, prefill.outstanding - 1)
@@ -1678,6 +1798,11 @@ class Gateway(Actor):
                     generate("process_frame_response", [
                         {"stream_id": stream.stream_id,
                          "frame_id": frame_id, "event": "error"}]))
+        for trace in stream.traces.values():
+            self.telemetry.frame_done(trace, status="error")
+        stream.traces.clear()
+        stream.dispatch_s.clear()
+        self.telemetry.forget_stream(stream.stream_id)
         stream.inflight.clear()
         self._paced_frames.pop(stream.stream_id, None)
         if stream.parked:
@@ -1693,6 +1818,16 @@ class Gateway(Actor):
         self._update_share()
 
     # -- observability -----------------------------------------------------
+
+    def publish_trace(self, topic_response) -> None:
+        """Wire query (`aiko trace collect`): publish this gateway's
+        self-describing Perfetto document, so a collector harvests the
+        fleet's per-process artifacts without filesystem access.  The
+        reply shape lives in observe/collector.py (shared with
+        Pipeline)."""
+        from ..observe import publish_trace_document
+        publish_trace_document(self.process, self.telemetry,
+                               self.topic_path, topic_response)
 
     def pool_snapshot(self) -> dict:
         """Per-replica pool view (replica topic, state, load gauges,

@@ -88,6 +88,11 @@ def build_report(loaded: LoadedTrace, model: CostModel, slo: SloSpec,
                 key: (round(value, 6)
                       if isinstance(value, float) else value)
                 for key, value in cost.engine.items()}
+        if cost.gateway is not None:
+            elements[name]["gateway"] = {
+                key: (round(value, 6)
+                      if isinstance(value, float) else value)
+                for key, value in cost.gateway.items()}
     dominant = ""
     if elements:
         observed = [(record["per_call_median_ms"], name)

@@ -10,12 +10,15 @@
 # absent" diagnostic and need a --definition side channel before any
 # classification can be attributed to typed nodes.
 #
-# The join: every element span ("cat": "element"/"queue"/"engine"/
-# "compile") is attributed to its graph node by name; spans naming a
-# node the definition does not declare, and definition elements that
-# never produced a span, both surface as diagnostics instead of being
-# silently dropped -- tune's whole value is that its numbers are
-# attributable.
+# The join: every span is attributed to its graph node by name, per
+# THE span taxonomy (categories, "{kind}:{node}" naming scheme, and
+# the time_queue_* vs time_* split) documented ONCE in
+# observe/trace.py's module docstring; "gateway"-category spans join
+# the "gateway" pseudo-node (no definition element by design).  Spans
+# naming a node the definition does not declare, and definition
+# elements that never produced a span, both surface as diagnostics
+# instead of being silently dropped -- tune's whole value is that its
+# numbers are attributable.
 
 from __future__ import annotations
 
@@ -56,6 +59,15 @@ class ElementProfile:
     engine_checkpoint_s: list = field(default_factory=list)
     engine_preemptions: int = 0
     engine_tokens: int = 0
+    # serving-gateway spans (fleet-scope traces): admit-wait (frame
+    # submit -> replica dispatch, parked wait included), route
+    # decision, failover replay waves, and shed/throttle counts --
+    # what the admission-bound floor classifies on
+    gateway_admit_s: list = field(default_factory=list)
+    gateway_route_s: list = field(default_factory=list)
+    gateway_replay_s: list = field(default_factory=list)
+    gateway_sheds: int = 0
+    gateway_throttles: int = 0
 
     @property
     def calls(self) -> int:
@@ -66,6 +78,15 @@ class ElementProfile:
         return bool(self.engine_prefill_s or self.engine_decode_s
                     or self.engine_adopt_s
                     or self.engine_checkpoint_s)
+
+    @property
+    def is_gateway(self) -> bool:
+        """A serving-tier profile (the "gateway" pseudo-node): joined
+        against no definition element, classified by the
+        admission-bound branch instead of the kernel floors."""
+        return bool(self.gateway_admit_s or self.gateway_route_s
+                    or self.gateway_replay_s or self.gateway_sheds
+                    or self.gateway_throttles)
 
 
 @dataclass
@@ -238,6 +259,11 @@ def _ingest_events(loaded: LoadedTrace, events: list,
     first_us = None
     last_us = None
     profiles = loaded.elements
+    # merged fleet artifacts carry one frame span PER PROCESS for the
+    # same logical frame (gateway root + each replica's slice, all
+    # sharing one trace id): keep the LONGEST span per trace id -- the
+    # root's end-to-end duration -- so frame stats are not inflated
+    frames_by_trace: dict = {}
     for event in events:
         if not isinstance(event, dict):
             continue
@@ -254,10 +280,18 @@ def _ingest_events(loaded: LoadedTrace, events: list,
             end = ts + (dur if isinstance(dur, (int, float)) else 0.0)
             last_us = end if last_us is None else max(last_us, end)
         if kind == "X" and category == "frame":
-            loaded.frame_durations_s.append(float(dur) / 1e6)
-            status = str(event.get("args", {}).get("status", "ok"))
-            loaded.frame_statuses[status] = (
-                loaded.frame_statuses.get(status, 0) + 1)
+            args = event.get("args") or {}
+            status = str(args.get("status", "ok"))
+            trace_id = args.get("trace_id")
+            duration_s = float(dur) / 1e6
+            if trace_id:
+                known = frames_by_trace.get(trace_id)
+                if known is None or duration_s > known[0]:
+                    frames_by_trace[trace_id] = (duration_s, status)
+            else:
+                loaded.frame_durations_s.append(duration_s)
+                loaded.frame_statuses[status] = (
+                    loaded.frame_statuses.get(status, 0) + 1)
             continue
         node = _node_of(name)
         if not node:
@@ -305,11 +339,39 @@ def _ingest_events(loaded: LoadedTrace, events: list,
             # engine-managed frames report their slot wait under a
             # row-suffixed queue span; an un-suffixed single-row one
             # lands in queue_s above, which is the same quantity
+        elif category == "gateway":
+            # serving-tier spans: "admit:gateway" / "route:gateway" /
+            # "replay:gateway" X spans plus shed/throttle instants --
+            # all attributed to the "gateway" pseudo-node (there is no
+            # matching definition element; _join skips it)
+            profile = profiles.setdefault(node, ElementProfile(node))
+            span = float(dur) / 1e6 if isinstance(
+                dur, (int, float)) else 0.0
+            if kind == "X" and name.startswith("admit:"):
+                profile.gateway_admit_s.append(span)
+            elif kind == "X" and name.startswith("route:"):
+                profile.gateway_route_s.append(span)
+            elif kind == "X" and ("replay:" in name):
+                profile.gateway_replay_s.append(span)
+            elif kind == "i" and name.startswith("shed:"):
+                profile.gateway_sheds += 1
+            elif kind == "i" and name.startswith("throttle:"):
+                # rate 0 is the LIFT instant (backpressure cleared):
+                # only count the onset, mirroring gateway.throttled
+                # vs gateway.unthrottled
+                rate = args.get("rate") if isinstance(args, dict) \
+                    else None
+                if not isinstance(rate, (int, float)) or rate > 0:
+                    profile.gateway_throttles += 1
         elif kind == "i" and category == "compile":
             if name.startswith("compile:"):
                 profile = profiles.setdefault(node,
                                               ElementProfile(node))
                 profile.compiles += 1
+    for duration_s, status in frames_by_trace.values():
+        loaded.frame_durations_s.append(duration_s)
+        loaded.frame_statuses[status] = (
+            loaded.frame_statuses.get(status, 0) + 1)
     if first_us is not None and last_us is not None:
         loaded.wall_s = max((last_us - first_us) / 1e6, 0.0)
 
@@ -328,6 +390,10 @@ def _join(loaded: LoadedTrace) -> None:
                 in loaded.definition.elements}
     for name in sorted(loaded.elements):
         if name not in declared:
+            if loaded.elements[name].is_gateway:
+                # the serving tier is not a graph element by design:
+                # its spans classify the admission-bound floor
+                continue
             loaded.diagnostic(
                 "AIKO503",
                 f"trace span node {name!r} is not an element of "

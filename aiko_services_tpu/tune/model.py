@@ -10,6 +10,13 @@
 # roofline" measured the per-call dispatch floor this formalizes).
 # Exactly one label per element, checked in priority order:
 #
+#   admission-bound (gateway pseudo-node only, fleet-scope traces) the
+#                   median admit-wait -- frame submit -> replica
+#                   dispatch, parked-queue wait included -- exceeds
+#                   the busiest element's compute+queue share: streams
+#                   wait at the GATE, not in any replica's kernel --
+#                   raise the replica floor and/or lower the admission
+#                   rate; no per-element knob can move this floor
 #   compile-bound   compile events keep firing past warmup: the
 #                   element re-specializes (shape churn / cohort
 #                   splits) and wall time is dominated by compilation
@@ -98,6 +105,9 @@ class ElementCost:
     paths: dict = field(default_factory=dict)
     compiles: int = 0
     engine: dict | None = None
+    # serving-gateway pseudo-node (fleet-scope traces): admit/route
+    # medians + replay/shed counts from the gateway's own spans
+    gateway: dict | None = None
     # static side (analyze/shape_eval.element_cost_estimates)
     flops_per_row: float | None = None
     bytes_per_row: float | None = None
@@ -150,6 +160,19 @@ class CostModel:
             cost.group_median = _median(profile.groups) or 1.0
             cost.per_call_median_s = (cost.compute_median_s
                                       * cost.group_median)
+            if profile.is_gateway:
+                cost.gateway = {
+                    "admit_median_s": _median(profile.gateway_admit_s),
+                    "admit_p90_s": _quantile(profile.gateway_admit_s,
+                                             0.9),
+                    "route_median_s": _median(profile.gateway_route_s),
+                    "admits": len(profile.gateway_admit_s),
+                    "replays": len(profile.gateway_replay_s),
+                    "replay_median_s": _median(
+                        profile.gateway_replay_s),
+                    "sheds": profile.gateway_sheds,
+                    "throttles": profile.gateway_throttles,
+                }
             if profile.is_engine_managed:
                 cost.engine = {
                     "queue_median_s": _median(
@@ -194,6 +217,21 @@ def classify_elements(model: CostModel) -> None:
     """Label every element's dominant floor, in place, with the
     evidence each label was computed from."""
     floor_s = model.dispatch_floor_s
+    # the fleet's busiest per-frame element share (compute + queue,
+    # engine phases included): the yardstick the gateway's admit-wait
+    # is judged against -- admission-bound means streams wait at the
+    # gate LONGER than any replica spends serving them
+    fleet_busy_s = 0.0
+    for cost in model.elements.values():
+        if cost.gateway is not None:
+            continue
+        engine = cost.engine or {}
+        compute = max(cost.compute_median_s,
+                      engine.get("prefill_median_s", 0.0)
+                      + engine.get("decode_median_s", 0.0))
+        queue_wait = max(cost.queue_median_s,
+                         engine.get("queue_median_s", 0.0))
+        fleet_busy_s = max(fleet_busy_s, compute + queue_wait)
     for cost in model.elements.values():
         evidence = {
             "calls": cost.calls,
@@ -214,6 +252,25 @@ def classify_elements(model: CostModel) -> None:
                 key: (round(value, 6)
                       if isinstance(value, float) else value)
                 for key, value in cost.engine.items()}
+        if cost.gateway is not None:
+            # the serving tier has exactly two states worth a label:
+            # the gate is the floor (admission-bound -- raise replicas
+            # / lower the rate), or the gateway's own per-frame work
+            # sits at the dispatch floor and the bottleneck is
+            # elsewhere (dispatch-bound: not the tier to tune)
+            gateway = cost.gateway
+            evidence["gateway"] = {
+                key: (round(value, 6)
+                      if isinstance(value, float) else value)
+                for key, value in gateway.items()}
+            evidence["fleet_busy_ms"] = round(fleet_busy_s * 1e3, 4)
+            cost.evidence = evidence
+            admit = gateway.get("admit_median_s", 0.0)
+            if admit > max(fleet_busy_s, floor_s):
+                cost.floor = "admission-bound"
+            else:
+                cost.floor = "dispatch-bound"
+            continue
         cost.evidence = evidence
         if cost.calls == 0 and cost.engine is None:
             cost.floor = "unobserved"

@@ -86,6 +86,13 @@ def recommend(model, slo, definition_document: dict | None) -> list:
         current_micro = settings["elements"].get(name, {}).get(
             "micro_batch", 1)
         parameters = element_parameters.get(name, {})
+        if cost.gateway is not None:
+            # serving-tier pseudo-node (fleet-scope traces): the
+            # kernel-floor branches below prescribe element knobs the
+            # gateway does not have
+            recommendations.extend(_gateway_floor_recommendations(
+                name, cost, pipeline_parameters, slo))
+            continue
         if cost.engine is not None:
             recommendations.extend(
                 _engine_recommendations(name, cost, parameters, slo))
@@ -215,6 +222,50 @@ def admission_recommendation(config: dict | None,
         f"measured capacity {capacity:g} frames/s ({source_key}): "
         "admitting at 90% keeps queue wait bounded under overload",
         floor="", evidence={source_key: capacity})
+
+
+def _gateway_floor_recommendations(name, cost, pipeline_parameters,
+                                   slo) -> list:
+    """The admission-bound branch: admit-wait (submit -> dispatch,
+    parked wait included) dominates every element's compute+queue
+    share, so streams wait at the GATE -- more replicas drain the
+    parked queue, and a rate cap keeps the wait bounded (the paired
+    admission_recommendation computes the rate from measured
+    capacity).  A dispatch-bound gateway gets no recommendation: it is
+    not the bottleneck tier."""
+    if cost.floor != "admission-bound":
+        return []
+    if slo.max_replicas <= 1:
+        # the operator pinned the fleet to one replica: recommending a
+        # higher floor would overrun the stated ceiling (mirroring the
+        # compute-bound replica-floor branch) -- only the paired
+        # admission-rate recommendation can help here
+        return []
+    gateway = cost.gateway or {}
+    evidence = dict(cost.evidence)
+    recommendations = []
+    floor = 2
+    current_policy = (pipeline_parameters or {}).get("autoscale_policy")
+    admit_ms = gateway.get("admit_median_s", 0.0) * 1e3
+    reason = (f"admission-bound: median admit-wait {admit_ms:.1f} ms "
+              f"exceeds the busiest element's compute+queue share "
+              f"({evidence.get('fleet_busy_ms', 0):g} ms) -- streams "
+              f"wait at the gate, not in any kernel; raise the replica "
+              f"floor (and cap the admission rate at measured "
+              f"capacity)")
+    if current_policy:
+        recommendations.append(Recommendation(
+            "gateway", "replicas", str(current_policy), floor,
+            reason + " -- an existing autoscale_policy is left "
+            "untouched: raise its min= floor manually",
+            floor=cost.floor, evidence=evidence))
+    else:
+        recommendations.append(Recommendation(
+            "gateway", "autoscale_policy", None,
+            f"min_replicas={floor};max_replicas="
+            f"{max(slo.max_replicas, floor)}",
+            reason, floor=cost.floor, evidence=evidence))
+    return recommendations
 
 
 def _engine_recommendations(name, cost, parameters, slo) -> list:

@@ -181,11 +181,13 @@ def _harvest_trace(pipeline, config_label: str | None = None) -> None:
     previous = run.get("metadata")
     if previous is not None:
         # several pipelines harvested under ONE config (router
-        # replicas, serving arms): the metrics snapshot must cover
-        # them ALL, not just the last -- counters from a
+        # replicas + the gateway, serving arms): the metrics snapshot
+        # must cover them ALL, not just the last -- counters from a
         # single-replica snapshot would understate an N-replica trace
         # -- and the pid list must name every tracer so the tune
-        # loader keeps all of this config's spans (and ONLY them)
+        # loader keeps all of this config's spans (and ONLY them).
+        # The gateway's metadata carries no definition: keep the
+        # replicas' (tune joins element spans against it)
         from aiko_services_tpu.observe import merge_snapshots
         metadata["metrics"] = merge_snapshots(
             previous.get("metrics") or {}, metadata.get("metrics")
@@ -193,6 +195,13 @@ def _harvest_trace(pipeline, config_label: str | None = None) -> None:
         metadata["pids"] = sorted(
             set(previous.get("pids") or [])
             | set(metadata.get("pids") or []))
+        for key in ("definition", "fingerprint"):
+            if key not in metadata and key in previous:
+                metadata[key] = previous[key]
+        if previous.get("role") != metadata.get("role"):
+            # replicas + gateway under one config: no single role
+            # describes the artifact (last-harvested must not win)
+            metadata.pop("role", None)
     run["metadata"] = metadata
 
 
@@ -990,17 +999,38 @@ def bench_latency(peak):
         ready_key="detections", window=1)
     flops = _multimodal_flops(asr_config, lm_config, det_config, batch,
                               max_tokens, max_new, audio_seconds)
-    return {"frames_per_sec_chip": round(fps, 2),
-            "telemetry": TELEMETRY,
-            **_latency_fields(p50, drain_pf),
-            "audio_seconds_per_frame": audio_seconds,
-            "rows_per_frame": batch,
-            "micro_batch": 1,
-            "frame_window": 1,
-            "operating_point": "latency (one frame in flight)",
-            "stages": (_MULTIMODAL_STAGES if not SMOKE
-                       else _MULTIMODAL_STAGES_SMOKE),
-            "mfu": _mfu(fps * flops, peak)}
+    result = {"frames_per_sec_chip": round(fps, 2),
+              "telemetry": TELEMETRY,
+              **_latency_fields(p50, drain_pf),
+              "audio_seconds_per_frame": audio_seconds,
+              "rows_per_frame": batch,
+              "micro_batch": 1,
+              "frame_window": 1,
+              "operating_point": "latency (one frame in flight)",
+              "stages": (_MULTIMODAL_STAGES if not SMOKE
+                         else _MULTIMODAL_STAGES_SMOKE),
+              "mfu": _mfu(fps * flops, peak)}
+    if TELEMETRY:
+        # tracing-overhead A/B on the latency operating point: the
+        # SAME graph with `telemetry: false` (the AIKO_BENCH_TELEMETRY
+        # knob's per-config form) -- the published delta is the cost
+        # of metrics + frame tracing per frame, where one frame is in
+        # flight and nothing amortizes it
+        off_definition, _, _, _ = _multimodal_setup(
+            "bench_latency_off", batch, 1, max_tokens, max_new,
+            audio_seconds, warmup + measure + 4)
+        off_definition.setdefault("parameters", {})["telemetry"] = False
+        off_fps, off_p50, off_drain, _ = _run_pipeline(
+            off_definition, warmup=warmup, measure=measure,
+            ready_key="detections", window=1)
+        off_fields = _latency_fields(off_p50, off_drain)
+        result["telemetry_off"] = {
+            "frames_per_sec_chip": round(off_fps, 2),
+            **off_fields,
+        }
+        result["tracing_overhead_p50_ms"] = round(
+            result["p50_ms"] - off_fields["p50_ms"], 2)
+    return result
 
 
 # -- config 6: many-stream serving (multitude) -------------------------------
@@ -1326,6 +1356,10 @@ def bench_router(peak, replicas_n: int):
     summary = gateway.telemetry.summary()
     for replica in replicas:  # every replica's spans, one router run
         _harvest_trace(replica, config_label="router")
+    # the GATEWAY contributes its root spans too (admit-wait, route,
+    # shed) -- without them the router trace had no admission story
+    # and `aiko tune` could only ever see the replica side
+    _harvest_trace(gateway, config_label="router")
     for proc in processes:
         proc.terminate()
     flops = detector_flops_per_image(config)

@@ -1,0 +1,96 @@
+# Telemetry-name audit (ISSUE 14 satellite): every counter / gauge /
+# histogram name the serving, decode, and pipeline layers write must
+# appear in the README's observability documentation -- undocumented
+# telemetry is telemetry nobody alarms on.
+#
+# The scan is an AST walk over the package sources: any call of the
+# form `<registry-ish>.counter("name")` / `.gauge("name")` /
+# `.histogram("name")` with a LITERAL first argument is harvested.
+# Dynamic families (f-strings like `gateway.routed:{replica}`) are
+# audited by their literal prefix where one exists in the same call
+# (JoinedStr leading literal), and skipped when fully dynamic.
+
+import ast
+import re
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "aiko_services_tpu"
+README = Path(__file__).resolve().parent.parent / "README.md"
+
+# the layers the audit covers (ISSUE 14: serve/, decode/, pipeline/ --
+# observe/ itself included since it defines the shared instruments)
+SCANNED_DIRS = ("serve", "decode", "pipeline", "observe")
+
+_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _instrument_names():
+    """{metric name (or family prefix) -> [source files]} from the
+    scanned sources."""
+    names: dict = {}
+    for directory in SCANNED_DIRS:
+        for path in sorted((PACKAGE / directory).glob("*.py")):
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not isinstance(
+                        node.func, ast.Attribute):
+                    continue
+                if node.func.attr not in _METHODS or not node.args:
+                    continue
+                argument = node.args[0]
+                name = None
+                if isinstance(argument, ast.Constant) and isinstance(
+                        argument.value, str):
+                    name = argument.value
+                elif isinstance(argument, ast.JoinedStr) \
+                        and argument.values \
+                        and isinstance(argument.values[0],
+                                       ast.Constant):
+                    # f"gateway.queue_depth:p{n}" -> family prefix
+                    name = str(argument.values[0].value)
+                elif isinstance(argument, ast.BinOp) and isinstance(
+                        argument.op, ast.Add) and isinstance(
+                        argument.left, ast.Constant):
+                    # "element_s:" + node -> family prefix
+                    name = str(argument.left.value)
+                if name:
+                    names.setdefault(name, []).append(
+                        str(path.relative_to(PACKAGE.parent)))
+    return names
+
+
+def _documented(name: str, readme_text: str) -> bool:
+    """A name is documented when the README mentions it verbatim, or
+    (for a family like "element_s:" / "gateway.queue_depth:p") mentions
+    the family with any suffix."""
+    base = name.rstrip(":")
+    if base.endswith(":p"):           # per-priority gauge families
+        base = base[:-2]
+    return base in readme_text
+
+
+def test_every_instrument_name_is_documented():
+    names = _instrument_names()
+    assert len(names) >= 40, (
+        f"audit scan looks broken: only {len(names)} instrument "
+        f"names found")
+    readme_text = README.read_text()
+    missing = {name: files for name, files in sorted(names.items())
+               if not _documented(name, readme_text)}
+    assert not missing, (
+        "telemetry names missing from the README "
+        "observability/telemetry tables (document them in the "
+        "'Telemetry reference' table):\n" + "\n".join(
+            f"  {name}  ({', '.join(sorted(set(files)))})"
+            for name, files in missing.items()))
+
+
+def test_readme_has_a_telemetry_reference_table():
+    text = README.read_text()
+    assert "### Telemetry reference" in text
+    # the table is real markdown, not prose: a header rule row exists
+    # within the section (before the next heading)
+    section = text.split("### Telemetry reference", 1)[1]
+    section = section.split("\n## ", 1)[0]
+    assert re.search(r"^\|[-| ]+\|$", section, re.MULTILINE), \
+        "telemetry reference section carries no markdown table"

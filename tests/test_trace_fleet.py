@@ -1,0 +1,614 @@
+# Fleet-scope distributed tracing (ISSUE 14): cross-process trace
+# propagation (gateway = root-span owner, replicas continue the same
+# trace), clock-aligned deterministic merging (observe/collector.py +
+# `aiko trace merge|collect`), per-stream end-to-end decomposition +
+# per-priority SLO accounting in the gateway summary, and the tune
+# loader's admission-bound floor over merged multi-process artifacts.
+#
+# The acceptance invariants: one merged artifact from a gateway +
+# >=2-replica (disagg) run shows a single stream's trace crossing >=3
+# processes with correct parent/child nesting and monotonic
+# clock-aligned timestamps; merging is byte-deterministic; `aiko tune`
+# classifies the admission-bound floor on a synthetic known-floor
+# fixture; and `telemetry: false` puts ZERO trace-context bytes on the
+# wire (frame payloads byte-identical to the untraced build).
+
+import json
+import queue
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.observe import (
+    TRACE_CONTEXT_KEY, Tracer, attach_trace_context,
+    chrome_trace_document, collect_traces, make_trace_context,
+    merge_trace_documents, merge_trace_files, pop_trace_context,
+    trace_context_of, trace_summary)
+from aiko_services_tpu.observe.trace import trace_metadata
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.pipeline.tensors import encode_frame_data
+from aiko_services_tpu.runtime import Process, Registrar
+from aiko_services_tpu.serve import Gateway
+from aiko_services_tpu.transport import reset_brokers
+
+from helpers import wait_for
+from test_serve import _frame, _replica_definition
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+def frame_events(document):
+    return [event for event in document["traceEvents"]
+            if event.get("ph") == "X" and event.get("cat") == "frame"]
+
+
+def gateway_events(document, prefix):
+    return [event for event in document["traceEvents"]
+            if event.get("cat") == "gateway"
+            and str(event.get("name", "")).startswith(prefix)]
+
+
+# -- trace context plumbing --------------------------------------------------
+
+
+class TestTraceContext:
+    def test_round_trip_and_adoption(self):
+        tracer = Tracer(pid=11)
+        root = tracer.begin("s", 3)
+        context = make_trace_context(root)
+        assert context == {"trace_id": root.trace_id,
+                           "span_id": root.span_id}
+        data = attach_trace_context({"x": 1}, context)
+        assert trace_context_of(data) == context
+        assert "x" in data
+        # attach copies: the original dict stays pristine (failover
+        # replay byte-equality depends on it)
+        original = {"x": 1}
+        attached = attach_trace_context(original, context)
+        assert TRACE_CONTEXT_KEY not in original
+        assert pop_trace_context(attached) == context
+        assert attached == original
+
+        downstream = Tracer(pid=22)
+        child = downstream.begin("s", 3)
+        child.adopt(context)
+        assert child.trace_id == root.trace_id
+        assert child.parent_span_id == root.span_id
+        downstream.finish(child)
+        [frame] = frame_events(
+            chrome_trace_document(downstream.chrome_events()))
+        assert frame["args"]["trace_id"] == root.trace_id
+        assert frame["args"]["parent"] == root.span_id
+        assert frame["args"]["span_id"] == child.span_id
+
+    def test_pop_is_ingress_safe(self):
+        assert pop_trace_context(None) is None
+        assert pop_trace_context({"a": 1}) is None
+        assert trace_context_of("not a dict") is None
+
+
+# -- gateway root spans + propagation over the serving tier ------------------
+
+
+class TestGatewayFleetTracing:
+    def _run_fleet(self, telemetry=True, slo_ms=0):
+        processes, replicas = [], []
+        for index in range(2):
+            process = Process(transport_kind="loopback")
+            processes.append(process)
+            replicas.append(create_pipeline(
+                process, _replica_definition(f"replica{index}")))
+        gateway_process = Process(transport_kind="loopback")
+        processes.append(gateway_process)
+        gateway = Gateway(gateway_process,
+                          policy="max_inflight=4;queue=16",
+                          telemetry=telemetry, metrics_interval=60.0)
+        for replica in replicas:
+            gateway.attach_replica(replica)
+        for process in processes:
+            process.run(in_thread=True)
+        responses = queue.Queue()
+        parameters = {"slo_ms": slo_ms} if slo_ms else {}
+        for stream in range(2):
+            gateway.submit_stream(f"s{stream}", parameters,
+                                  queue_response=responses)
+        done = 0
+        for stream in range(2):
+            for frame_id in range(3):
+                gateway.submit_frame(f"s{stream}", _frame(frame_id),
+                                     frame_id=frame_id)
+        while done < 6:
+            item = responses.get(timeout=30)
+            assert item[3] == "ok", item
+            done += 1
+        return gateway, replicas, processes
+
+    def test_root_spans_and_cross_process_continuation(self):
+        gateway, replicas, processes = self._run_fleet()
+        try:
+            documents = [("gateway", chrome_trace_document(
+                gateway.telemetry.chrome_events(),
+                metadata=gateway.telemetry.trace_metadata()))]
+            for index, replica in enumerate(replicas):
+                documents.append((f"replica{index}",
+                                  chrome_trace_document(
+                                      replica.telemetry.chrome_events(),
+                                      metadata=replica.telemetry
+                                      .trace_metadata())))
+            gateway_doc = documents[0][1]
+            # the gateway emitted real admit-wait and route spans
+            assert len(gateway_events(gateway_doc, "admit:")) == 6
+            assert len(gateway_events(gateway_doc, "route:")) == 6
+            merged = merge_trace_documents(documents)
+            summary = trace_summary(merged)
+            # every admitted frame's trace crosses gateway + replica,
+            # parent-linked with no dangling references
+            assert summary["traces"] == 6
+            assert summary["multi_process_traces"] == 6
+            assert summary["max_processes_per_trace"] == 2
+            assert summary["linked_spans"] >= 6
+            assert summary["dangling_parents"] == []
+            # replica frame spans carry the GATEWAY's trace ids
+            gateway_ids = {event["args"]["trace_id"]
+                           for event in frame_events(gateway_doc)}
+            for _name, document in documents[1:]:
+                for event in frame_events(document):
+                    assert event["args"]["trace_id"] in gateway_ids
+                    assert "parent" in event["args"]
+            # merged timestamps are monotonic (sorted) and clock
+            # alignment keeps the gateway's root start at/before its
+            # replica continuation
+            timestamps = [event.get("ts", 0.0)
+                          for event in merged["traceEvents"]
+                          if event.get("ph") != "M"]
+            assert timestamps == sorted(timestamps)
+            spans = {event["args"]["span_id"]: event
+                     for event in merged["traceEvents"]
+                     if event.get("cat") == "frame"
+                     and "span_id" in event.get("args", {})}
+            linked = 0
+            for event in merged["traceEvents"]:
+                parent = event.get("args", {}).get("parent")
+                if parent and parent in spans:
+                    linked += 1
+                    assert spans[parent]["ts"] <= event["ts"] + 1.0
+            assert linked >= 6
+        finally:
+            for process in processes:
+                process.terminate()
+
+    def test_slo_counters_and_decomposition(self):
+        gateway, _replicas, processes = self._run_fleet(slo_ms=30000)
+        try:
+            summary = gateway.telemetry.summary()
+            slo = summary["slo"]
+            assert slo["0"]["ok"] == 6
+            assert slo["0"]["miss"] == 0
+            assert slo["0"]["attainment"] == 1.0
+            assert slo["0"]["burn"] == 0.0
+            decomposition = summary["stream_decomposition"]
+            for stream in ("s0", "s1"):
+                stages = decomposition[stream]
+                for stage in ("admit", "route", "queue", "decode",
+                              "emit"):
+                    assert stage in stages, (stream, stages)
+            total = decomposition["_total"]
+            assert total["decode"] > 0
+            # destroyed streams fold into the persistent total
+            gateway.destroy_stream("s0")
+            wait_for(lambda: "s0" not in gateway.streams)
+            after = gateway.telemetry.summary()[
+                "stream_decomposition"]
+            assert "s0" not in after
+            assert after["_total"]["decode"] >= total["decode"] - 0.001
+        finally:
+            for process in processes:
+                process.terminate()
+
+    def test_telemetry_off_zero_trace_bytes_on_the_wire(self):
+        """The zero-overhead contract: with gateway telemetry off the
+        dispatched frame payload is the SAME object content as the
+        submitted frame data -- byte-identical on the wire codec, no
+        trace-context key, no frame traces anywhere."""
+        processes = []
+        replica_process = Process(transport_kind="loopback")
+        processes.append(replica_process)
+        replica = create_pipeline(replica_process, _replica_definition(
+            "replica0", parameters={"telemetry": False}))
+        gateway_process = Process(transport_kind="loopback")
+        processes.append(gateway_process)
+        gateway = Gateway(gateway_process,
+                          policy="max_inflight=4;queue=16",
+                          telemetry=False)
+        gateway.attach_replica(replica)
+        dispatched = []
+        original_post = replica.post_message
+
+        def recording_post(command, parameters, **kwargs):
+            if command == "process_frame":
+                dispatched.append(parameters[1])
+            return original_post(command, parameters, **kwargs)
+
+        replica.post_message = recording_post
+        for process in processes:
+            process.run(in_thread=True)
+        try:
+            responses = queue.Queue()
+            gateway.submit_stream("s0", queue_response=responses)
+            frame_data = _frame(7)
+            reference_bytes = encode_frame_data(frame_data)
+            gateway.submit_frame("s0", frame_data, frame_id=0)
+            assert responses.get(timeout=30)[3] == "ok"
+            assert len(dispatched) == 1
+            payload = dispatched[0]
+            assert TRACE_CONTEXT_KEY not in payload
+            # byte-compare against the seed wire encoding: tracing off
+            # means the frame payload is EXACTLY what was submitted
+            assert encode_frame_data(payload) == reference_bytes
+            assert payload is frame_data  # no copy either
+            # and no spans were recorded anywhere
+            assert not gateway.telemetry.tracer.completed
+            assert not replica.telemetry.tracer.completed
+        finally:
+            for process in processes:
+                process.terminate()
+
+    def test_telemetry_on_context_rides_but_never_leaks(self):
+        gateway, replicas, processes = self._run_fleet()
+        try:
+            # element inputs/outputs never see the reserved key: the
+            # replica pops it at stream ingress
+            for replica in replicas:
+                for trace in replica.telemetry.tracer.completed:
+                    assert trace.origin_trace_id is not None
+        finally:
+            for process in processes:
+                process.terminate()
+
+
+# -- merging: clock calibration + byte determinism ---------------------------
+
+
+def _synthetic_document(pid, epoch_us, name="proc", span_ts=1000.0):
+    # ids are pid-derived exactly like FrameTrace's ({pid:x}-{seq:x} /
+    # {pid:x}.{seq:x}): the collision test proves the merger rewrites
+    # them alongside the event pid
+    events = [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+         "args": {"name": name}},
+        {"ph": "X", "name": "frame 0", "cat": "frame", "ts": span_ts,
+         "dur": 500.0, "pid": pid, "tid": 1,
+         "args": {"trace_id": f"{pid:x}-1", "span_id": f"{pid:x}.1",
+                  "status": "ok", "stream": "s"}},
+    ]
+    metadata = trace_metadata()
+    metadata["clock_epoch_unix_us"] = epoch_us
+    return chrome_trace_document(events, metadata=metadata)
+
+
+class TestMerge:
+    def test_clock_alignment_shifts_to_the_earliest_epoch(self):
+        # process B booted 2 s after A: B's local ts 1000 is wall time
+        # 2_001_000 on A's timeline
+        doc_a = _synthetic_document(1, 1_000_000.0, "a")
+        doc_b = _synthetic_document(2, 3_000_000.0, "b")
+        merged = merge_trace_documents([("a", doc_a), ("b", doc_b)])
+        spans = {event["pid"]: event
+                 for event in frame_events(merged)}
+        assert spans[1]["ts"] == 1000.0
+        assert spans[2]["ts"] == 1000.0 + 2_000_000.0
+        aiko = merged["metadata"]["aiko"]
+        assert aiko["clock_epoch_unix_us"] == 1_000_000.0
+        assert aiko["merged"]["b"]["offset_us"] == 2_000_000.0
+
+    def test_pid_collisions_remap_deterministically(self):
+        doc_a = _synthetic_document(7, 0.0, "a")
+        doc_b = _synthetic_document(7, 0.0, "b")
+        merged = merge_trace_documents([("a", doc_a), ("b", doc_b)])
+        assert sorted(event["pid"]
+                      for event in frame_events(merged)) == [7, 8]
+        assert merged["metadata"]["aiko"]["merged"]["b"]["pids"] == [8]
+        # pid-derived trace/span ids are rewritten WITH the pid:
+        # two unrelated hosts must not read as one trace
+        ids = {event["pid"]: event["args"]
+               for event in frame_events(merged)}
+        assert ids[7]["trace_id"] == "7-1"
+        assert ids[8]["trace_id"] == "8-1"
+        assert ids[8]["span_id"] == "8.1"
+        assert trace_summary(merged)["traces"] == 2
+        assert merged["metadata"]["aiko"]["pid_collisions"] == {
+            "7": ["b"]}
+
+    def test_collision_remap_preserves_propagated_links(self):
+        # a colliding replica doc ADOPTED the gateway's trace: its own
+        # span_id is rewritten with the fresh pid, but the propagated
+        # trace_id and parent were minted by the GATEWAY (which keeps
+        # pid 7) -- rewriting them would split the cross-process trace
+        # the merger exists to preserve
+        gateway_doc = _synthetic_document(7, 0.0, "gateway")
+        replica_doc = _synthetic_document(7, 0.0, "replica")
+        replica_doc["traceEvents"][1]["args"] = {
+            "trace_id": "7-1", "span_id": "7.2", "parent": "7.1",
+            "status": "ok", "stream": "s"}
+        merged = merge_trace_documents([("gateway", gateway_doc),
+                                        ("replica", replica_doc)])
+        ids = {event["pid"]: event["args"]
+               for event in frame_events(merged)}
+        assert ids[8]["span_id"] == "8.2"      # locally minted
+        assert ids[8]["trace_id"] == "7-1"     # gateway's, untouched
+        assert ids[8]["parent"] == "7.1"       # gateway's, untouched
+        summary = trace_summary(merged)
+        assert summary["multi_process_traces"] == 1
+        assert summary["dangling_parents"] == []
+
+    def test_summary_counts_span_id_less_parent_links(self):
+        # adopt spans carry a cross-process parent but no span_id of
+        # their own: a broken link must still surface as dangling
+        document = _synthetic_document(3, 0.0, "decode")
+        document["traceEvents"].append(
+            {"ph": "X", "name": "adopt:lm", "cat": "engine",
+             "ts": 1100.0, "dur": 50.0, "pid": 3, "tid": 1,
+             "args": {"trace_id": "3-1", "parent": "dead.1"}})
+        summary = trace_summary(document)
+        assert summary["linked_spans"] == 1
+        assert summary["dangling_parents"] == ["adopt:lm@1100.0"]
+
+    def test_unaligned_sources_are_flagged_not_dropped(self):
+        doc = _synthetic_document(1, 0.0, "a")
+        foreign = {"traceEvents": [
+            {"ph": "X", "name": "x", "cat": "element", "ts": 5.0,
+             "dur": 1.0, "pid": 9, "tid": 0, "args": {}}]}
+        merged = merge_trace_documents([("a", doc),
+                                        ("foreign", foreign)])
+        assert merged["metadata"]["aiko"]["unaligned_sources"] == [
+            "foreign"]
+        assert len(merged["traceEvents"]) == 3
+
+    def test_merge_files_is_byte_deterministic(self, tmp_path):
+        doc_a = _synthetic_document(1, 1_000.0, "a")
+        doc_b = _synthetic_document(2, 9_000.0, "b")
+        for name, document in (("a", doc_a), ("b", doc_b)):
+            (tmp_path / f"{name}.json").write_text(
+                json.dumps(document))
+        inputs = [str(tmp_path / "b.json"), str(tmp_path / "a.json")]
+        out1, out2 = str(tmp_path / "m1.json"), str(tmp_path / "m2.json")
+        merge_trace_files(inputs, output=out1)
+        merge_trace_files(list(reversed(inputs)), output=out2)
+        bytes1 = open(out1, "rb").read()
+        bytes2 = open(out2, "rb").read()
+        assert bytes1 == bytes2  # input ORDER is normalized away
+        assert len(bytes1) > 0
+
+    def test_rejects_non_trace_documents(self):
+        with pytest.raises(ValueError):
+            merge_trace_documents([("bad", {"nope": 1})])
+
+
+# -- acceptance: three processes on one stream's trace (disagg) --------------
+
+
+class TestThreeProcessTrace:
+    def test_disagg_frame_crosses_gateway_prefill_decode(self):
+        """One stream's frame: gateway root span -> prefill replica
+        child span -> decode replica child span (adopt parented under
+        the PREFILL hop via the handoff descriptor) -- >=3 processes on
+        one merged, clock-aligned timeline."""
+        from test_disagg import make_decode_pipeline, \
+            make_prefill_pipeline
+        processes = []
+        prefill_process = Process(transport_kind="loopback")
+        processes.append(prefill_process)
+        prefill_pipe = make_prefill_pipeline(prefill_process, "pre0")
+        decode_process = Process(transport_kind="loopback")
+        processes.append(decode_process)
+        decode_pipe = make_decode_pipeline(decode_process, "dec0")
+        gateway_process = Process(transport_kind="loopback")
+        processes.append(gateway_process)
+        gateway = Gateway(gateway_process,
+                          policy="max_inflight=8;queue=32",
+                          disagg="adopt_timeout=5",
+                          metrics_interval=60.0)
+        gateway.attach_replica(prefill_pipe)
+        gateway.attach_replica(decode_pipe)
+        for process in processes:
+            process.run(in_thread=True)
+        try:
+            rng = np.random.default_rng(5)
+            responses = queue.Queue()
+            gateway.submit_stream("g1", {}, queue_response=responses)
+            for frame_id in range(2):
+                gateway.submit_frame(
+                    "g1",
+                    {"tokens": rng.integers(
+                        1, 300, size=(1, 6)).astype(np.int32)},
+                    frame_id=frame_id)
+            for _ in range(2):
+                assert responses.get(timeout=120)[3] == "ok"
+            documents = [
+                ("gateway", chrome_trace_document(
+                    gateway.telemetry.chrome_events(),
+                    metadata=gateway.telemetry.trace_metadata())),
+                ("pre0", chrome_trace_document(
+                    prefill_pipe.telemetry.chrome_events(),
+                    metadata=prefill_pipe.telemetry.trace_metadata())),
+                ("dec0", chrome_trace_document(
+                    decode_pipe.telemetry.chrome_events(),
+                    metadata=decode_pipe.telemetry.trace_metadata())),
+            ]
+            merged = merge_trace_documents(documents)
+            summary = trace_summary(merged)
+            assert summary["max_processes_per_trace"] >= 3, summary
+            assert summary["dangling_parents"] == []
+            # nesting: both replica frame spans parent under the SAME
+            # gateway root span for a given trace id
+            gateway_spans = {event["args"]["span_id"]
+                             for event in frame_events(documents[0][1])}
+            crossing = {}
+            for event in frame_events(merged):
+                args = event["args"]
+                if args.get("parent") in gateway_spans:
+                    crossing.setdefault(args["trace_id"], []).append(
+                        event["pid"])
+            assert any(len(set(pids)) >= 2
+                       for pids in crossing.values()), crossing
+            # the decode replica's adopt span links to the prefill hop
+            adopt_parents = [
+                event["args"].get("parent")
+                for event in merged["traceEvents"]
+                if str(event.get("name", "")).startswith("adopt:")]
+            prefill_spans = {event["args"]["span_id"]
+                             for event in frame_events(documents[1][1])}
+            assert any(parent in prefill_spans
+                       for parent in adopt_parents), adopt_parents
+            # decomposition saw the prefill hop
+            decomposition = gateway.telemetry.summary()[
+                "stream_decomposition"]
+            assert decomposition["g1"]["prefill"] > 0
+        finally:
+            for process in processes:
+                process.terminate()
+
+
+# -- tune: the admission-bound floor over a merged fleet artifact ------------
+
+
+def synthesize_admission_bound_document():
+    """A deterministic known-floor fixture: gateway admit-waits of
+    ~80 ms dominate a 1 ms replica element -- streams wait at the
+    gate."""
+    events = []
+    for index in range(20):
+        base = 1000.0 + index * 100_000.0
+        trace_id = f"t-{index}"
+        events.append({"ph": "X", "name": f"frame {index}",
+                       "cat": "frame", "ts": base, "dur": 82_000.0,
+                       "pid": 1, "tid": 1,
+                       "args": {"trace_id": trace_id,
+                                "span_id": f"1.{index}",
+                                "status": "ok", "stream": "s"}})
+        events.append({"ph": "X", "name": "admit:gateway",
+                       "cat": "gateway", "ts": base,
+                       "dur": 80_000.0, "pid": 1, "tid": 1,
+                       "args": {"trace_id": trace_id}})
+        events.append({"ph": "X", "name": "route:gateway",
+                       "cat": "gateway", "ts": base + 80_000.0,
+                       "dur": 50.0, "pid": 1, "tid": 1,
+                       "args": {"trace_id": trace_id,
+                                "replica": "replica0"}})
+        events.append({"ph": "X", "name": f"frame {index}",
+                       "cat": "frame", "ts": base + 80_100.0,
+                       "dur": 1_200.0, "pid": 2, "tid": 1,
+                       "args": {"trace_id": trace_id,
+                                "span_id": f"2.{index}",
+                                "parent": f"1.{index}",
+                                "status": "ok", "stream": "s"}})
+        events.append({"ph": "X", "name": "scale", "cat": "element",
+                       "ts": base + 80_200.0, "dur": 1_000.0,
+                       "pid": 2, "tid": 1,
+                       "args": {"trace_id": trace_id,
+                                "path": "inline"}})
+    metadata = trace_metadata(definition_document=json.loads(
+        json.dumps(_replica_definition("replica0"))))
+    metadata["clock_epoch_unix_us"] = 0.0
+    metadata["pids"] = [1, 2]
+    return chrome_trace_document(events, metadata=metadata)
+
+
+class TestAdmissionBoundFloor:
+    def test_classifies_and_recommends_replicas(self, tmp_path):
+        from aiko_services_tpu.tune import run_tune
+        path = tmp_path / "admission_bound.json"
+        path.write_text(json.dumps(
+            synthesize_admission_bound_document()))
+        report = run_tune(str(path))
+        gateway_record = report["elements"]["gateway"]
+        assert gateway_record["floor"] == "admission-bound"
+        evidence = gateway_record["evidence"]["gateway"]
+        assert evidence["admit_median_s"] == pytest.approx(0.080)
+        assert gateway_record["evidence"]["fleet_busy_ms"] == \
+            pytest.approx(1.0)
+        # the replica element itself stays an ordinary floor -- the
+        # gate, not the kernel, is the bottleneck
+        assert report["elements"]["scale"]["floor"] != "unobserved"
+        targets = {(record["target"], record["knob"]):
+                   record for record in report["recommendations"]}
+        replica_rec = targets[("gateway", "autoscale_policy")]
+        assert "admission-bound" in replica_rec["reason"]
+        assert "min_replicas=2" in str(replica_rec["proposed"])
+        # no AIKO503 complaint about the gateway pseudo-node
+        assert not any("gateway" in diagnostic["message"]
+                       for diagnostic in report["diagnostics"])
+
+    def test_report_is_deterministic(self, tmp_path):
+        from aiko_services_tpu.tune import report_json, run_tune
+        path = tmp_path / "admission_bound.json"
+        path.write_text(json.dumps(
+            synthesize_admission_bound_document()))
+        first = report_json(run_tune(str(path)))
+        second = report_json(run_tune(str(path)))
+        assert first == second
+
+    def test_healthy_gateway_classifies_dispatch_bound(self, tmp_path):
+        """Admit-wait BELOW the busiest element: the gateway is not the
+        bottleneck tier and gets no recommendation."""
+        from aiko_services_tpu.tune import run_tune
+        document = synthesize_admission_bound_document()
+        for event in document["traceEvents"]:
+            if event.get("name") == "admit:gateway":
+                event["dur"] = 100.0    # 0.1 ms << the 1 ms element
+        path = tmp_path / "healthy.json"
+        path.write_text(json.dumps(document))
+        report = run_tune(str(path))
+        assert report["elements"]["gateway"]["floor"] == \
+            "dispatch-bound"
+        assert not any(record["floor"] == "admission-bound"
+                       for record in report["recommendations"])
+
+
+# -- live collection over the control plane ----------------------------------
+
+
+class TestCollect:
+    def test_collects_gateway_and_pipeline_documents(self):
+        processes = []
+        registrar_process = Process(transport_kind="loopback")
+        processes.append(registrar_process)
+        Registrar(registrar_process)
+        replica_process = Process(transport_kind="loopback")
+        processes.append(replica_process)
+        replica = create_pipeline(replica_process,
+                                  _replica_definition("replica0"))
+        gateway_process = Process(transport_kind="loopback")
+        processes.append(gateway_process)
+        gateway = Gateway(gateway_process,
+                          policy="max_inflight=4;queue=16",
+                          metrics_interval=60.0)
+        gateway.attach_replica(replica)
+        client = Process(transport_kind="loopback")
+        processes.append(client)
+        for process in processes:
+            process.run(in_thread=True)
+        try:
+            responses = queue.Queue()
+            gateway.submit_stream("s0", queue_response=responses)
+            gateway.submit_frame("s0", _frame(1), frame_id=0)
+            assert responses.get(timeout=30)[3] == "ok"
+            collected = collect_traces(client, wait=2.0)
+            if (gateway.topic_path not in collected
+                    or replica.topic_path not in collected):
+                # registrar discovery syncs async; a loaded CI box can
+                # outlast the short wait -- one longer retry absorbs it
+                collected = collect_traces(client, wait=6.0)
+            assert gateway.topic_path in collected
+            assert replica.topic_path in collected
+            merged = merge_trace_documents(sorted(collected.items()))
+            summary = trace_summary(merged)
+            assert summary["multi_process_traces"] >= 1
+        finally:
+            for process in processes:
+                process.terminate()
