@@ -70,6 +70,7 @@ RULES = {
     "AIKO408": ("error", "invalid prefill/decode disaggregation spec"),
     "AIKO409": ("error", "invalid decode checkpoint/recovery policy "
                          "spec"),
+    "AIKO410": ("error", "invalid gateway federation spec"),
     # -- AIKO5xx: profile-guided tuning (tune/) --------------------------
     "AIKO501": ("error", "invalid tune SLO/directive spec"),
     "AIKO502": ("warning", "tune recommendation not applicable to the "

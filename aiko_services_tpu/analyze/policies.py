@@ -16,10 +16,10 @@ from .grammar import Field, GrammarError, split_directives
 __all__ = ["run_policy_pass", "check_gateway_policy",
            "check_autoscale_policy", "check_checkpoint_policy",
            "check_disagg_policy", "check_faults_spec",
-           "check_journal_policy", "check_decode_parameters",
-           "check_tune_spec", "parse_speculative_spec",
-           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS", "DISAGG_FIELDS",
-           "SPECULATIVE_FIELDS"]
+           "check_federation_policy", "check_journal_policy",
+           "check_decode_parameters", "check_tune_spec",
+           "parse_speculative_spec", "FAULT_TOLERANCE_FIELDS",
+           "DECODE_FIELDS", "DISAGG_FIELDS", "SPECULATIVE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -336,6 +336,22 @@ def check_autoscale_policy(spec) -> list:
     return problems
 
 
+def check_federation_policy(spec) -> list:
+    """(code, message) problems in a federated-gateway spec.  Same
+    shape as check_gateway_policy: the per-directive grammar check as
+    AIKO410, then the REAL FederationPolicy.parse so cross-field
+    constraints (non-empty unique groups, own group in the set) fail
+    offline exactly as Gateway construction would."""
+    from ..serve.federation import FEDERATION_GRAMMAR, FederationPolicy
+    problems = FEDERATION_GRAMMAR.check(spec, value_code="AIKO410")
+    if not problems:
+        try:
+            FederationPolicy.parse(spec)
+        except ValueError as error:
+            problems.append(("AIKO410", str(error)))
+    return problems
+
+
 def run_policy_pass(definition) -> AnalysisReport:
     report = AnalysisReport(passes_run=["policy"])
     name = definition.name
@@ -419,6 +435,13 @@ def run_policy_pass(definition) -> AnalysisReport:
     journal_spec = (definition.parameters or {}).get("journal_policy")
     if journal_spec:
         for code, message in check_journal_policy(journal_spec):
+            report.add(Diagnostic(code, message, definition=name))
+    # `federation_policy` is the gateway-side federated-tier spec
+    # embedded next to the definition (stream -> group consistent hash)
+    federation_spec = (definition.parameters or {}).get(
+        "federation_policy")
+    if federation_spec:
+        for code, message in check_federation_policy(federation_spec):
             report.add(Diagnostic(code, message, definition=name))
     tune_spec = (definition.parameters or {}).get("tune")
     if tune_spec:

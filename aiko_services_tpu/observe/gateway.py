@@ -374,7 +374,9 @@ class GatewayTelemetry:
                 generate("metrics",
                          [gateway.topic_path, self.snapshot()]))
             if gateway.ec_producer is not None:
-                gateway.ec_producer.update("metrics", self.summary())
+                # staged: the summary mirror coalesces with any
+                # stream-churn share updates pending this tick
+                gateway.ec_producer.stage("metrics", self.summary())
         except Exception as error:  # export must never kill the gateway
             _LOGGER.warning("gateway metrics publish failed: %s", error)
 

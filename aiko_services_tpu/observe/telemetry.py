@@ -552,15 +552,19 @@ class PipelineTelemetry:
                     self.process_snapshot()]))
             if pipeline.ec_producer is not None:
                 summary = self.summary()
-                pipeline.ec_producer.update("metrics", summary)
-                # top-level scalars as well: the serving gateway's
+                # COALESCED: the summary + load scalars fold into ONE
+                # delta payload per lease per tick (stage/flush), with
+                # unchanged scalars dropped from the payload -- the
+                # telemetry tick costs one control-plane publish per
+                # consumer, not three.  The serving gateway's
                 # ECConsumer mirror reads plain `inflight` /
                 # `queue_depth` keys (nested dicts are awkward over the
                 # EC wire), refreshed here between stream-churn updates
                 load = summary.get("load") or {}
-                pipeline.ec_producer.update(
+                pipeline.ec_producer.stage("metrics", summary)
+                pipeline.ec_producer.stage(
                     "inflight", load.get("inflight", 0))
-                pipeline.ec_producer.update(
+                pipeline.ec_producer.stage(
                     "queue_depth", load.get("queue_depth", 0))
         except Exception as error:  # export must never kill the engine
             _LOGGER.warning("metrics publish failed: %s", error)
@@ -573,9 +577,13 @@ class PipelineTelemetry:
         try:
             if pipeline.ec_producer is not None:
                 load = pipeline.load()
-                pipeline.ec_producer.update(
-                    "inflight", load.get("inflight", 0))
-                pipeline.ec_producer.update(
+                # staged, with `inflight` forced: one delta payload per
+                # heartbeat (the forced key keeps the gateway's
+                # staleness clock -- ECConsumer.last_update -- ticking
+                # for an idle replica whose load never changes)
+                pipeline.ec_producer.stage(
+                    "inflight", load.get("inflight", 0), force=True)
+                pipeline.ec_producer.stage(
                     "queue_depth", load.get("queue_depth", 0))
         except Exception as error:
             _LOGGER.warning("load heartbeat failed: %s", error)

@@ -1947,12 +1947,18 @@ class Pipeline(Actor):
         admission caps consume exactly this dict -- locally for
         in-process replicas, via the EC share (below, plus the periodic
         telemetry summary) for remote ones."""
+        # gateways read this CROSS-THREAD per routing decision while
+        # this pipeline's own loop churns streams: snapshot the dicts
+        # atomically (list() never yields the GIL) before iterating --
+        # a generator over the live dict raised "dictionary changed
+        # size during iteration" under a 1,000-stream creation storm,
+        # silently losing the create that was being routed
+        streams = list(self.streams.values())
+        pending = list(self._micro_pending.values())
         return {
-            "inflight": sum(
-                stream.pending for stream in self.streams.values()),
-            "queue_depth": sum(
-                len(entries) for entries in self._micro_pending.values()),
-            "streams": len(self.streams),
+            "inflight": sum(stream.pending for stream in streams),
+            "queue_depth": sum(len(entries) for entries in pending),
+            "streams": len(streams),
         }
 
     def publish_trace(self, topic_response) -> None:
@@ -1984,8 +1990,11 @@ class Pipeline(Actor):
 
     def _update_stream_share(self) -> None:
         if self.ec_producer is not None:
-            self.ec_producer.update("stream_count", len(self.streams))
-            self.ec_producer.update("frame_count", self._frame_count)
+            # staged: stream/frame churn folds into one delta payload
+            # per drained mailbox burst instead of two publishes per
+            # lease per frame (see ECProducer.stage)
+            self.ec_producer.stage("stream_count", len(self.streams))
+            self.ec_producer.stage("frame_count", self._frame_count)
             # refresh the load gauge consumed by serving gateways --
             # but load() is O(streams + parked), so a creation BURST
             # (thousands of streams, the lease-jitter scenario) must
@@ -1996,9 +2005,9 @@ class Pipeline(Actor):
             if now - getattr(self, "_load_shared_at", 0.0) >= 0.2:
                 self._load_shared_at = now
                 load = self.load()
-                self.ec_producer.update("inflight", load["inflight"])
-                self.ec_producer.update("queue_depth",
-                                        load["queue_depth"])
+                self.ec_producer.stage("inflight", load["inflight"])
+                self.ec_producer.stage("queue_depth",
+                                       load["queue_depth"])
 
     # -- checkpoint / resume (no reference counterpart: SURVEY.md section 5
     # "Checkpoint/resume: absent"; required for preemptible TPU recovery) --

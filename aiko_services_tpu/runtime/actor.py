@@ -132,6 +132,13 @@ class Actor(Service):
     def _mailbox_handler(self, mailbox_name: str, message) -> None:
         message.invoke()
 
+    def _ec_flush_staged(self) -> None:
+        """Mailbox continuation of ECProducer.stage(): the flush
+        message queues behind the churn burst that staged the updates,
+        so one delta publish covers the whole drained burst."""
+        if self.ec_producer is not None:
+            self.ec_producer.flush_staged()
+
     def _ec_change_hook(self, command: str, name: str, value) -> None:
         """Live log_level updates via the share dict, e.g. dashboard
         publishing "(update log_level DEBUG)" to /control (reference

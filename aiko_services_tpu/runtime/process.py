@@ -24,7 +24,7 @@ import threading
 from ..transport import create_transport
 from ..utils import (
     generate, parse, get_hostname, get_namespace, get_logger, epoch_now)
-from ..transport.base import topic_matches
+from ..transport.trie import TopicTrie
 from .connection import Connection, ConnectionState
 from .event import EventEngine
 from .service import ServiceFields
@@ -60,6 +60,15 @@ class Process:
         self._services: dict[int, object] = {}
         self._service_sequence = itertools.count(1)
         self._message_handlers: dict[str, list] = {}
+        # trie-indexed dispatch (transport/trie.py): each inbound
+        # message walks the topic's levels once instead of scanning
+        # every registered pattern -- the per-message cost that used to
+        # grow with every service/stream this process hosts.  The
+        # per-pattern sequence number reproduces the historical dict
+        # insertion order across handlers of different patterns
+        self._handler_trie = TopicTrie()
+        self._handler_sequence = itertools.count()
+        self._handler_order: dict[str, int] = {}
         self._handlers_lock = threading.Lock()
         self._pending_registrations: list = []
 
@@ -177,6 +186,9 @@ class Process:
         with self._handlers_lock:
             first = topic not in self._message_handlers
             self._message_handlers.setdefault(topic, []).append(handler)
+            if first:
+                self._handler_trie.add(topic, topic)
+                self._handler_order[topic] = next(self._handler_sequence)
         if first:
             self.transport.subscribe(topic)
 
@@ -188,6 +200,8 @@ class Process:
                 handlers.remove(handler)
             if not handlers and topic in self._message_handlers:
                 del self._message_handlers[topic]
+                self._handler_trie.discard(topic, topic)
+                self._handler_order.pop(topic, None)
                 last = True
         if last:
             self.transport.unsubscribe(topic)
@@ -200,10 +214,13 @@ class Process:
     def _message_queue_handler(self, item) -> None:
         topic, payload = item
         with self._handlers_lock:
+            patterns = self._handler_trie.match(topic)
+            patterns.sort(key=lambda pattern: self._handler_order.get(
+                pattern, 0))
             matched = [handler
-                       for pattern, handlers in self._message_handlers.items()
-                       if topic_matches(pattern, topic)
-                       for handler in handlers]
+                       for pattern in patterns
+                       for handler in self._message_handlers.get(
+                           pattern, ())]
         for handler in matched:
             try:
                 handler(topic, payload)

@@ -29,6 +29,24 @@ _EC_COMMANDS = frozenset(("add", "update", "remove", "share"))
 DEFAULT_LEASE_TIME = 300.0  # seconds (reference share.py:86)
 
 
+_SHARE_COUNTERS = None
+
+
+def _share_counters():
+    """(publishes, delta_publishes, updates_coalesced) resolved ONCE
+    from the process-global registry -- stage() rides stream-churn
+    storms, so the per-update cost must stay a plain int add (the
+    counters feed the bench `control_plane` block)."""
+    global _SHARE_COUNTERS
+    if _SHARE_COUNTERS is None:
+        from ..observe.metrics import get_registry
+        registry = get_registry()
+        _SHARE_COUNTERS = (registry.counter("share.publishes"),
+                           registry.counter("share.delta_publishes"),
+                           registry.counter("share.updates_coalesced"))
+    return _SHARE_COUNTERS
+
+
 def _get_nested(share: dict, name: str):
     if "." in name:
         head, tail = name.split(".", 1)
@@ -74,6 +92,16 @@ class ECProducer:
             service, "share", {})
         self._leases: dict[str, Lease] = {}  # response_topic -> Lease
         self._change_handlers: list = []
+        # coalesced publishing (stage/flush_staged): a burst of staged
+        # updates within one event-loop tick folds into ONE `(delta
+        # {...})` payload per lease -- the control-plane publish count
+        # becomes O(ticks), not O(updates).  `_last_flushed` shadows
+        # published SCALAR values so an unchanged re-stage publishes
+        # nothing at all
+        self._staged: dict = {}
+        self._forced: set = set()
+        self._last_flushed: dict = {}
+        self._flush_scheduled = False
         # every Actor auto-creates a producer (reference actor.py:199-205);
         # an explicit later ECProducer(service) replaces it cleanly
         previous = getattr(service, "ec_producer", None)
@@ -131,6 +159,7 @@ class ECProducer:
             publish(response_topic, generate("add", [name, value]))
         publish(response_topic,
                 generate("sync", [self.service.topic_state]))
+        _share_counters()[0].inc(len(items) + 2)
 
     # -- local API ---------------------------------------------------------
 
@@ -139,18 +168,96 @@ class ECProducer:
 
     def update(self, name: str, value) -> None:
         _set_nested(self.share, name, value)
+        # an immediate update SUPERSEDES any pending staged value for
+        # the same key: a deferred delta flush must not later overwrite
+        # this broadcast with a stale value, and the unchanged-scalar
+        # suppression must judge future stages against THIS value
+        self._staged.pop(name, None)
+        if isinstance(value, (int, float, str, bool)):
+            self._last_flushed[name] = value
+        else:
+            self._last_flushed.pop(name, None)
         self._broadcast("update", name, value)
 
     def remove(self, name: str) -> None:
         _remove_nested(self.share, name)
+        self._staged.pop(name, None)   # a staged write must not resurrect it
+        # forget the published shadow too: re-staging the key with its
+        # pre-remove value must publish (consumers dropped the key)
+        self._last_flushed.pop(name, None)
         self._broadcast("remove", name, None)
+
+    def stage(self, name: str, value, force: bool = False) -> None:
+        """Coalesced update: the local share (and change handlers) see
+        the value NOW; the lease publishes fold into one delta payload
+        per event-loop tick (flush rides the owning actor's mailbox, so
+        a registration/stream-churn storm drains before the flush
+        runs).  Use for high-churn keys (service_count, load gauges,
+        telemetry summaries); update() stays the immediate path.
+        `force` publishes the key even when its scalar value is
+        unchanged -- heartbeat keys that refresh a consumer's
+        staleness clock (ECConsumer.last_update) must hit the wire."""
+        _set_nested(self.share, name, value)
+        self._staged[name] = value
+        if force:
+            self._forced.add(name)
+        _share_counters()[2].inc()
+        for handler in self._change_handlers:
+            handler("update", name, value)
+        self._schedule_flush()
+
+    def _schedule_flush(self) -> None:
+        if self._flush_scheduled:
+            return
+        self._flush_scheduled = True
+        post = getattr(self.service, "post_message", None)
+        if post is not None:
+            # the flush message queues BEHIND whatever churn is already
+            # in the mailbox: one delta per drained burst
+            post("_ec_flush_staged", [])
+        else:
+            event = self.service.process.event
+
+            def fire():
+                event.remove_timer_handler(fire)
+                self.flush_staged()
+
+            event.add_timer_handler(fire, 0.005)
+
+    def flush_staged(self) -> None:
+        self._flush_scheduled = False
+        staged, self._staged = self._staged, {}
+        forced, self._forced = self._forced, set()
+        if not staged:
+            return
+        payload_dict = {}
+        for name, value in staged.items():
+            if (name not in forced
+                    and isinstance(value, (int, float, str, bool))
+                    and name in self._last_flushed
+                    and self._last_flushed.get(name) == value):
+                continue   # unchanged scalar: nothing to sync
+            payload_dict[name] = value
+            if isinstance(value, (int, float, str, bool)):
+                self._last_flushed[name] = value
+        if not payload_dict or not self._leases:
+            return
+        publish = self.service.process.publish
+        payload = generate("delta", [payload_dict])
+        publishes, delta_publishes, _ = _share_counters()
+        for response_topic in list(self._leases):
+            publish(response_topic, payload)
+            publishes.inc()
+        delta_publishes.inc()
 
     def _broadcast(self, command: str, name: str, value) -> None:
         publish = self.service.process.publish
         parameters = [name] if value is None else [name, value]
         payload = generate(command, parameters)
+        publishes = _share_counters()[0]
         for response_topic in list(self._leases):
             publish(response_topic, payload)
+            publishes.inc()
         for handler in self._change_handlers:
             handler(command, name, value)
 
@@ -158,6 +265,7 @@ class ECProducer:
         for lease in self._leases.values():
             lease.terminate()
         self._leases.clear()
+        self._staged.clear()
 
 
 class ECConsumer:
@@ -211,6 +319,14 @@ class ECConsumer:
         elif command in ("add", "update") and len(parameters) >= 2:
             _set_nested(self.cache, parameters[0], parameters[1])
             self._notify(command, parameters[0], parameters[1])
+        elif command == "delta" and parameters:
+            # coalesced producer flush: one payload, many keys --
+            # mirrored per key so change handlers see ordinary updates
+            changes = parameters[0]
+            if isinstance(changes, dict):
+                for name, value in changes.items():
+                    _set_nested(self.cache, name, value)
+                    self._notify("update", name, value)
         elif command == "remove" and parameters:
             _remove_nested(self.cache, parameters[0])
             self._notify(command, parameters[0], None)

@@ -20,6 +20,9 @@ from .journal import (                                    # noqa: F401
     GatewayJournal, JournalPolicy)
 from .disagg import (                                     # noqa: F401
     DISAGG_GRAMMAR, DisaggPolicy)
+from .federation import (                                 # noqa: F401
+    FEDERATION_GRAMMAR, FederationPolicy, FederationRouter,
+    assign_group)
 from .gateway import Gateway, SERVICE_PROTOCOL_GATEWAY    # noqa: F401
 from .autoscale import (                                  # noqa: F401
     AutoScaler, InProcessReplicaFactory, ProcessReplicaFactory,

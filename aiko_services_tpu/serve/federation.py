@@ -1,0 +1,165 @@
+# Federated gateway tier: multiple HA gateway groups sharing one
+# replica fleet, with streams assigned to groups by CONSISTENT HASH of
+# the stream id.
+#
+# Why: one gateway actor is a single event loop -- at O(10k) concurrent
+# streams its mailbox becomes the serving tier's ceiling.  Federation
+# splits the stream space across G independent gateway groups (each
+# optionally an HA pair via the existing `ha=<group>` RetainedElection,
+# serve/gateway.py) that all front the SAME replica pool.  Because the
+# assignment is a pure function of (stream id, group set), every
+# client, every gateway, and every test computes the same placement
+# with no coordination, and a group's crash-failover composes
+# unchanged: journals are already namespaced per group
+# ("{ns}/gateway/{group}/journal"), so the group's standby adopts
+# exactly its own streams.
+#
+# Assignment is rendezvous (highest-random-weight) hashing over a
+# stable digest -- adding or removing one group moves only ~1/G of the
+# streams, and the hash is identical across processes and Python runs
+# (hashlib, never the salted builtin hash()).
+#
+# Grammar (gateway parameter `federation`, the shared directive style):
+#
+#   policy    := directive (";" directive)*
+#   directive := "groups=" name ("," name)*   the full group set (the
+#                                             hash domain; identical
+#                                             on every member)
+#              | "group=" name                THIS gateway's own group
+#                                             (defaults to its ha
+#                                             group, else its name)
+#
+# Example: "groups=g0,g1,g2,g3;group=g1"
+#
+# A federated gateway REJECTS streams that hash to another group with
+# the typed shed reason "wrong_group" -- a misconfigured client fails
+# fast instead of splitting a stream's frames across groups.
+# Validation is at parse time through the shared directive core
+# (analyze/grammar.py): `aiko lint` checks it offline as AIKO410 with
+# the same messages Gateway construction raises.
+
+from __future__ import annotations
+
+import hashlib
+
+from ..analyze.grammar import DirectiveGrammar, Field, GrammarError
+
+__all__ = ["FEDERATION_GRAMMAR", "FederationPolicy", "FederationRouter",
+           "assign_group"]
+
+FEDERATION_GRAMMAR = DirectiveGrammar(
+    "federation policy",
+    options={
+        "groups": Field("str"),
+        "group": Field("str"),
+    })
+
+
+def assign_group(stream_id, groups) -> str:
+    """The federated tier's ONE placement rule: rendezvous hashing of
+    `stream_id` over `groups`.  Pure and process-stable (md5, not the
+    salted builtin hash), so clients and gateways agree with no
+    coordination; ties break to the lexicographically first group."""
+    stream_id = str(stream_id)
+    best = None
+    best_score = -1
+    for group in sorted(groups):
+        digest = hashlib.md5(
+            f"{group}\x00{stream_id}".encode("utf-8")).digest()
+        score = int.from_bytes(digest[:8], "big")
+        if score > best_score:
+            best, best_score = group, score
+    if best is None:
+        raise ValueError("assign_group needs a non-empty group set")
+    return best
+
+
+class FederationPolicy:
+    """Parsed federation spec: the full group set plus this gateway's
+    own group (None = derive from ha group / gateway name)."""
+
+    __slots__ = ("groups", "group", "spec")
+
+    def __init__(self):
+        self.groups: tuple[str, ...] = ()
+        self.group: str | None = None
+        self.spec = ""
+
+    @classmethod
+    def parse(cls, spec) -> "FederationPolicy":
+        policy = cls()
+        if spec is None or spec == "":
+            return policy
+        if isinstance(spec, FederationPolicy):
+            return spec
+        parsed = FEDERATION_GRAMMAR.parse(spec)
+        if not isinstance(spec, dict):
+            policy.spec = str(spec)
+        raw = parsed.options.get("groups", "")
+        if isinstance(raw, (list, tuple)):
+            names = [str(name).strip() for name in raw]
+        else:
+            names = [name.strip() for name in str(raw).split(",")]
+        names = [name for name in names if name]
+        if not names:
+            raise GrammarError(
+                "federation policy: groups= needs at least one group "
+                "name (e.g. groups=g0,g1)")
+        if len(set(names)) != len(names):
+            raise GrammarError(
+                f"federation policy: duplicate group names in "
+                f"groups={','.join(names)}")
+        policy.groups = tuple(names)
+        own = parsed.options.get("group")
+        if own is not None:
+            own = str(own).strip()
+            if own not in policy.groups:
+                raise GrammarError(
+                    f"federation policy: group={own!r} is not in "
+                    f"groups={','.join(policy.groups)}")
+            policy.group = own
+        return policy
+
+    def owner_of(self, stream_id) -> str:
+        return assign_group(stream_id, self.groups)
+
+    def __repr__(self):
+        return (f"FederationPolicy(groups={list(self.groups)}, "
+                f"group={self.group})")
+
+
+class FederationRouter:
+    """Client-side stream placement over a federated tier: holds one
+    gateway handle (or submit surface) per group and forwards each
+    stream's calls to the group its id hashes to -- the same
+    assign_group the gateways enforce, so a routed stream is never
+    shed wrong_group.  Handles are anything with submit_stream /
+    submit_frame / destroy-by-post (the Gateway local surface); tests
+    and the bench use in-process Gateway objects directly."""
+
+    def __init__(self, gateways: dict):
+        if not gateways:
+            raise ValueError("FederationRouter needs at least one group")
+        self.gateways = dict(gateways)
+        self.groups = tuple(sorted(self.gateways))
+
+    def group_for(self, stream_id) -> str:
+        return assign_group(stream_id, self.groups)
+
+    def gateway_for(self, stream_id):
+        return self.gateways[self.group_for(stream_id)]
+
+    def submit_stream(self, stream_id, **kwargs) -> str:
+        """Create the stream on its consistent-hash group; returns the
+        group name (callers correlate responses per group)."""
+        group = self.group_for(stream_id)
+        self.gateways[group].submit_stream(stream_id, **kwargs)
+        return group
+
+    def submit_frame(self, stream_id, frame_data, frame_id=None) -> None:
+        self.gateway_for(stream_id).submit_frame(
+            stream_id, frame_data, frame_id=frame_id)
+
+    def destroy_stream(self, stream_id) -> None:
+        self.gateway_for(stream_id).post_message(
+            "destroy_stream", [stream_id])

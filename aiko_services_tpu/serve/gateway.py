@@ -243,7 +243,7 @@ class Gateway(Actor):
                  router_seed: int = 0, faults=None, telemetry: bool = True,
                  metrics_interval: float = 10.0, autoscale=None,
                  replica_factory=None, journal=None, ha=None,
-                 disagg=None, checkpoint=None):
+                 disagg=None, checkpoint=None, federation=None):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
         # construction-time validation through the shared
         # directive-grammar core (analyze/grammar.py): a typo'd policy
@@ -295,6 +295,34 @@ class Gateway(Actor):
             raise ValueError(
                 f"{code}: gateway checkpoint policy rejected: "
                 f"{error}") from None
+        # federated tier (serve/federation.py): with a federation spec
+        # set, this gateway owns exactly the streams whose id hashes to
+        # its group (rendezvous over the full group set) and sheds the
+        # rest with the typed reason "wrong_group" -- a misrouted
+        # client fails fast instead of splitting a stream across
+        # groups.  None (the default) = single-group tier, behavior
+        # identical to every pre-federation deployment
+        try:
+            from .federation import FederationPolicy
+            self.federation = (FederationPolicy.parse(federation)
+                               if federation is not None else None)
+        except ValueError as error:
+            code = ("AIKO404" if getattr(error, "kind", "") == "unknown"
+                    else "AIKO410")
+            raise ValueError(
+                f"{code}: gateway federation policy rejected: "
+                f"{error}") from None
+        self.federation_group = None
+        if self.federation is not None and self.federation.groups:
+            self.federation_group = (self.federation.group
+                                     or (str(ha) if ha else None) or name)
+            if self.federation_group not in self.federation.groups:
+                raise ValueError(
+                    f"AIKO410: gateway federation policy rejected: this "
+                    f"gateway's group {self.federation_group!r} (from "
+                    f"ha/name) is not in groups="
+                    f"{','.join(self.federation.groups)}; set group= "
+                    f"explicitly")
         # stream_id -> {"ids": [frame ids], "hint": restore hint}:
         # failover replays deferred by recovery pacing -- in inflight,
         # neither dispatched nor parked.  The hint is FROZEN at
@@ -356,6 +384,12 @@ class Gateway(Actor):
             "stream_count": 0,
             "role": self.role,
         })
+        if self.federation_group is not None:
+            # discovery surface: clients resolving the tier can read
+            # each gateway's group off its EC share
+            self.share["federation_group"] = self.federation_group
+            self.share["federation_groups"] = ",".join(
+                self.federation.groups)
         self._ha_was_secondary = False
         if self.ha_group:
             self.role = "standby"
@@ -1111,6 +1145,15 @@ class Gateway(Actor):
             self._reject_stream(stream_id, "duplicate_stream_id",
                                 topic_response, queue_response)
             return
+        if (self.federation_group is not None
+                and self.federation.owner_of(stream_id)
+                != self.federation_group):
+            # federated tier: the stream hashes to ANOTHER group --
+            # shed before the token bucket (a misrouted client must
+            # not burn this group's admission budget)
+            self._reject_stream(stream_id, "wrong_group",
+                                topic_response, queue_response)
+            return
         now = time.monotonic()
         bucket = self.policy.bucket_for(priority)
         if bucket is not None:
@@ -1854,9 +1897,13 @@ class Gateway(Actor):
         self.telemetry.replicas.set(len(self.replicas))
         self.telemetry.pool_size.set(len(self.replicas))
         if self.ec_producer is not None:
-            self.ec_producer.update("replica_count", len(self.replicas))
-            self.ec_producer.update("stream_count", len(self.streams))
-            self.ec_producer.update("role", self.role)
+            # staged: a stream-churn storm (create/destroy per frame at
+            # O(10k) streams) folds its share refreshes into one delta
+            # per drained mailbox burst, and unchanged scalars
+            # (replica_count, role) drop out of the payload entirely
+            self.ec_producer.stage("replica_count", len(self.replicas))
+            self.ec_producer.stage("stream_count", len(self.streams))
+            self.ec_producer.stage("role", self.role)
 
     def stop(self) -> None:
         if self.autoscaler is not None:

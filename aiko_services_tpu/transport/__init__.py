@@ -1,4 +1,5 @@
 from .base import Transport, topic_matches                    # noqa: F401
+from .trie import TopicTrie                                   # noqa: F401
 from .loopback import (                                       # noqa: F401
     LoopbackBroker, LoopbackTransport, get_broker, reset_brokers)
 from .null import NullTransport                               # noqa: F401

@@ -13,10 +13,14 @@
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
+import time
+import zlib
 
 from .base import Transport, topic_matches
+from .trie import TopicTrie
 
 __all__ = ["LoopbackBroker", "LoopbackTransport", "get_broker", "reset_brokers"]
 
@@ -42,16 +46,60 @@ def reset_brokers() -> None:
 
 
 class LoopbackBroker:
-    def __init__(self, name: str = "default"):
+    """In-process broker with a topic-trie subscription index.
+
+    Matching: a publish routes through a broker-side TopicTrie mapping
+    each subscription pattern to its clients -- one trie walk per
+    message instead of scanning every client's whole pattern set
+    (`match_mode="linear"` keeps the historical O(clients x patterns)
+    scan as the bench A/B arm; delivery semantics are identical: same
+    messages, same per-topic order).  Clients with zero matching
+    subscriptions are never woken (`broker.fanout_avoided`).
+
+    Sharded dispatch: `shards` (or AIKO_BROKER_SHARDS) runs N dispatch
+    workers with topic-hashed queues -- the SAME topic always lands on
+    the SAME worker, so per-topic delivery order (and therefore the
+    bit-identity discipline that rides per-stream order) is preserved
+    while unrelated topics stop convoying each other.  Default 1: one
+    thread, exactly the historical global ordering."""
+
+    def __init__(self, name: str = "default", shards: int | None = None,
+                 match_mode: str | None = None):
         self.name = name
         self._lock = threading.Lock()
         self._clients: list[LoopbackTransport] = []
+        self._trie = TopicTrie()
         self._retained: dict[str, str] = {}
-        self._queue: queue.Queue = queue.Queue()
+        self.match_mode = (match_mode
+                           or os.environ.get("AIKO_BROKER_MATCH", "trie"))
+        if shards is None:
+            try:
+                shards = int(os.environ.get("AIKO_BROKER_SHARDS", "1"))
+            except ValueError:
+                shards = 1
+        self._shards = max(1, shards)
         self._alive = True
-        self._thread = threading.Thread(
-            target=self._dispatch_loop, name=f"loopback-{name}", daemon=True)
-        self._thread.start()
+        # instruments resolved once (observe/metrics.py global
+        # registry): the per-message cost is int adds + one bisect
+        from ..observe.metrics import get_registry
+        registry = get_registry()
+        self._m_messages = registry.counter("broker.messages")
+        self._m_delivered = registry.counter("broker.fanout_delivered")
+        self._m_avoided = registry.counter("broker.fanout_avoided")
+        self._m_match = registry.histogram("broker.match_s")
+        self._queues = [queue.Queue() for _ in range(self._shards)]
+        self._threads = [
+            threading.Thread(
+                target=self._dispatch_loop, args=(shard_queue,),
+                name=f"loopback-{name}-{index}", daemon=True)
+            for index, shard_queue in enumerate(self._queues)]
+        for thread in self._threads:
+            thread.start()
+
+    def _shard_of(self, topic: str) -> int:
+        if self._shards == 1:
+            return 0
+        return zlib.crc32(topic.encode("utf-8")) % self._shards
 
     # -- client management -------------------------------------------------
 
@@ -59,14 +107,28 @@ class LoopbackBroker:
         with self._lock:
             if client not in self._clients:
                 self._clients.append(client)
+                for pattern in client.subscription_snapshot():
+                    self._trie.add(pattern, client)
 
     def detach(self, client: "LoopbackTransport", send_lwt: bool) -> None:
         with self._lock:
             if client in self._clients:
                 self._clients.remove(client)
+                self._trie.remove_value(client)
         if send_lwt:
             for topic, (payload, retain) in list(client.wills.items()):
                 self.publish(topic, payload, retain=retain)
+
+    def subscribe_client(self, client: "LoopbackTransport",
+                         pattern: str) -> None:
+        with self._lock:
+            if client in self._clients:
+                self._trie.add(pattern, client)
+
+    def unsubscribe_client(self, client: "LoopbackTransport",
+                           pattern: str) -> None:
+        with self._lock:
+            self._trie.discard(pattern, client)
 
     # -- pub/sub -----------------------------------------------------------
 
@@ -78,7 +140,7 @@ class LoopbackBroker:
                     self._retained.pop(topic, None)  # MQTT clears on empty
                 else:
                     self._retained[topic] = payload
-        self._queue.put(("publish", topic, payload))
+        self._queues[self._shard_of(topic)].put(("publish", topic, payload))
 
     def deliver_retained(self, client: "LoopbackTransport",
                          pattern: str) -> None:
@@ -87,40 +149,74 @@ class LoopbackBroker:
                        for topic, payload in self._retained.items()
                        if topic_matches(pattern, topic)]
         for topic, payload in matches:
-            self._queue.put(("retained", topic, payload, client))
+            # retained replays shard by TOPIC too, so they order
+            # consistently against live publishes on the same topic
+            self._queues[self._shard_of(topic)].put(
+                ("retained", topic, payload, client))
 
     def retained(self, topic: str):
         with self._lock:
             return self._retained.get(topic)
 
-    # -- dispatch thread ---------------------------------------------------
+    # -- dispatch threads --------------------------------------------------
 
-    def _dispatch_loop(self) -> None:
+    def _dispatch_loop(self, shard_queue: queue.Queue) -> None:
         while True:
-            item = self._queue.get()
+            item = shard_queue.get()
             if item is None:
                 return
             if item[0] == "publish":
                 _, topic, payload = item
-                with self._lock:
-                    clients = list(self._clients)
-                for client in clients:
-                    client._maybe_deliver(topic, payload)
+                matched = self._match_clients(topic)
+                for client in matched:
+                    if client._connected:
+                        client._deliver(topic, payload)
             else:  # retained delivery to one client
                 _, topic, payload, client = item
                 client._deliver(topic, payload)
 
+    def _match_clients(self, topic: str) -> list:
+        """The clients this message must wake.  Trie-mode order is
+        deterministic (client_id); per-client per-topic order -- the
+        contract bit-identity rides on -- is identical in both modes,
+        cross-client interleaving was never guaranteed."""
+        start = time.perf_counter()
+        if self.match_mode == "linear":
+            # A/B reference arm: the historical per-client linear scan
+            with self._lock:
+                clients = list(self._clients)
+            matched = [client for client in clients
+                       if client._subscription_match_linear(topic)]
+            total = len(clients)
+        else:
+            with self._lock:
+                matched = self._trie.match(topic)
+                total = len(self._clients)
+            matched.sort(key=lambda client: client.client_id)
+        self._m_match.record(time.perf_counter() - start)
+        self._m_messages.inc()
+        self._m_delivered.inc(len(matched))
+        self._m_avoided.inc(total - len(matched))
+        return matched
+
     def drain(self, timeout: float = 5.0) -> None:
         """Block until every queued delivery has been dispatched (tests)."""
-        done = threading.Event()
-        self._queue.put(("retained", None, None, _Sentinel(done)))
-        done.wait(timeout)
+        events = []
+        for shard_queue in self._queues:
+            done = threading.Event()
+            events.append(done)
+            shard_queue.put(("retained", None, None, _Sentinel(done)))
+        deadline = time.monotonic() + timeout
+        for done in events:
+            done.wait(max(0.0, deadline - time.monotonic()))
 
     def shutdown(self) -> None:
         if self._alive:
             self._alive = False
-            self._queue.put(None)
-            self._thread.join(timeout=2)
+            for shard_queue in self._queues:
+                shard_queue.put(None)
+            for thread in self._threads:
+                thread.join(timeout=2)
 
 
 class _Sentinel:
@@ -249,11 +345,21 @@ class LoopbackTransport(Transport):
                 return
             self._subscriptions.add(topic)
         if self._broker is not None and self._connected:
+            # broker-side routing index: only attached clients index
+            # (subscribe_client checks membership, so a partitioned
+            # client's new patterns wait for heal()'s re-attach)
+            self._broker.subscribe_client(self, topic)
             self._broker.deliver_retained(self, topic)
 
     def unsubscribe(self, topic: str) -> None:
         with self._lock:
             self._subscriptions.discard(topic)
+        if self._broker is not None:
+            self._broker.unsubscribe_client(self, topic)
+
+    def subscription_snapshot(self) -> list[str]:
+        with self._lock:
+            return list(self._subscriptions)
 
     def set_last_will_and_testament(
             self, topic: str, payload, retain: bool = False) -> None:
@@ -267,15 +373,19 @@ class LoopbackTransport(Transport):
         return self._connected
 
     # -- broker-side delivery (broker dispatch thread) ---------------------
+    #
+    # Routing moved broker-side: the broker's TopicTrie picks the
+    # matched clients and calls _deliver directly, so subscribed-set
+    # scans no longer ride the per-message hot path at all.
 
-    def _maybe_deliver(self, topic: str, payload: str) -> None:
+    def _subscription_match_linear(self, topic: str) -> bool:
+        """The historical O(patterns) scan -- kept as the broker's
+        `match_mode="linear"` A/B reference arm."""
         if not self._connected:
-            return
+            return False
         with self._lock:
-            matched = any(topic_matches(pattern, topic)
-                          for pattern in self._subscriptions)
-        if matched:
-            self._deliver(topic, payload)
+            return any(topic_matches(pattern, topic)
+                       for pattern in self._subscriptions)
 
     def _deliver(self, topic: str, payload: str) -> None:
         if self.on_message is not None:

@@ -18,6 +18,7 @@
 
 from __future__ import annotations
 
+import os as _os
 import socket
 import struct
 import threading
@@ -26,6 +27,7 @@ import time as _time
 from ..observe.metrics import get_registry
 from ..utils import get_logger
 from .base import topic_matches
+from .trie import TopicTrie
 
 __all__ = ["CallbackAPIVersion", "Client", "MiniMqttBroker"]
 
@@ -152,6 +154,17 @@ class MiniMqttBroker:
         self.retained: dict[str, bytes] = {}
         self._sessions: list[_Session] = []
         self._lock = threading.Lock()
+        # trie-indexed routing (transport/trie.py): one walk over the
+        # topic's levels per publish instead of every session's whole
+        # filter list; AIKO_BROKER_MATCH=linear keeps the historical
+        # scan as the A/B reference arm (same instruments either way)
+        self._trie = TopicTrie()
+        self.match_mode = _os.environ.get("AIKO_BROKER_MATCH", "trie")
+        registry = get_registry()
+        self._m_messages = registry.counter("broker.messages")
+        self._m_delivered = registry.counter("broker.fanout_delivered")
+        self._m_avoided = registry.counter("broker.fanout_avoided")
+        self._m_match = registry.histogram("broker.match_s")
         self._running = True
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="minimqtt-broker", daemon=True)
@@ -216,6 +229,7 @@ class MiniMqttBroker:
             with self._lock:
                 if session in self._sessions:
                     self._sessions.remove(session)
+                self._trie.remove_value(session)
             try:
                 session.sock.close()
             except OSError:
@@ -259,6 +273,8 @@ class MiniMqttBroker:
                 reader.chunk(1)                  # requested qos
                 if topic_filter not in session.filters:
                     session.filters.append(topic_filter)
+                    with self._lock:
+                        self._trie.add(topic_filter, session)
                 new_filters.append(topic_filter)
                 granted.append(0x00)
             session.send(_packet(SUBACK, 0,
@@ -274,6 +290,8 @@ class MiniMqttBroker:
                 topic_filter = reader.string().decode("utf-8", "replace")
                 if topic_filter in session.filters:
                     session.filters.remove(topic_filter)
+                    with self._lock:
+                        self._trie.discard(topic_filter, session)
             session.send(_packet(UNSUBACK, 0,
                                  struct.pack(">H", packet_id)))
         elif packet_type == PINGREQ:
@@ -295,12 +313,26 @@ class MiniMqttBroker:
                 self.retained[topic] = payload
             else:
                 self.retained.pop(topic, None)  # empty payload clears
-        with self._lock:
-            sessions = list(self._sessions)
+        start = _time.perf_counter()
+        if self.match_mode == "linear":
+            with self._lock:
+                sessions = list(self._sessions)
+                total = len(sessions)
+            matched = [session for session in sessions
+                       if any(topic_matches(f, topic)
+                              for f in session.filters)]
+        else:
+            with self._lock:
+                matched = self._trie.match(topic)
+                total = len(self._sessions)
+            matched.sort(key=id)   # deterministic within one route
+        self._m_match.record(_time.perf_counter() - start)
+        self._m_messages.inc()
+        self._m_delivered.inc(len(matched))
+        self._m_avoided.inc(total - len(matched))
         packet = self._publish_packet(topic, payload)
-        for session in sessions:
-            if any(topic_matches(f, topic) for f in session.filters):
-                session.send(packet)
+        for session in matched:
+            session.send(packet)
 
     def _publish_will(self, session: _Session) -> None:
         if session.will is None or session.will_sent:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import queue
+import random
 import sys
 import time
 
@@ -3099,6 +3100,318 @@ def _tts_definition(phrase, batch, count):
     }
 
 
+# -- scale: ten-thousand-stream control-plane scale-out ----------------------
+
+# one spec, three surfaces: the running gateways, the definition
+# parameter `aiko lint --bench` checks (AIKO403/AIKO410), and the
+# published config block.  max_inflight is sized so the storm never
+# parks (the bounded parked queue's linear scans are the OLD ceiling
+# this config exists to measure past); the queue is a backstop only.
+_SCALE_POLICY = "max_inflight=16384;queue=2048"
+_SCALE_GROUPS = ("g0", "g1", "g2", "g3")
+_SCALE_FEDERATION = f"groups={','.join(_SCALE_GROUPS)}"
+
+
+class _ControlPlaneMeter:
+    """Control-plane cost window around one config's run: broker
+    message rate, registrar registration qps, and EC share sync rate
+    from the process-global counter deltas -- published as the
+    `control_plane` sub-block of every pipeline-running config so
+    future `aiko tune` work can see the control plane's share of each
+    workload."""
+
+    def __init__(self):
+        from aiko_services_tpu.observe.metrics import get_registry
+        self._registry = get_registry()
+        self._start = time.perf_counter()
+        self._before = dict(self._registry.snapshot()["counters"])
+
+    def block(self) -> dict:
+        counters = self._registry.snapshot()["counters"]
+        elapsed = max(time.perf_counter() - self._start, 1e-9)
+
+        def delta(name):
+            return counters.get(name, 0) - self._before.get(name, 0)
+
+        broker_msgs = delta("broker.messages")
+        registrar_ops = delta("registrar.adds") + delta(
+            "registrar.removes")
+        ec_syncs = delta("share.publishes")
+        return {
+            "window_s": round(elapsed, 3),
+            "broker_msgs": broker_msgs,
+            "broker_msgs_per_s": round(broker_msgs / elapsed, 1),
+            "broker_fanout_avoided": delta("broker.fanout_avoided"),
+            "registrar_ops": registrar_ops,
+            "registrar_qps": round(registrar_ops / elapsed, 1),
+            "ec_syncs": ec_syncs,
+            "ec_syncs_per_s": round(ec_syncs / elapsed, 1),
+            "ec_updates_coalesced": delta("share.updates_coalesced"),
+            "ec_delta_publishes": delta("share.delta_publishes"),
+        }
+
+
+def _with_control_plane(bench_fn, *args):
+    """Run one config with a control-plane cost window around it."""
+    meter = _ControlPlaneMeter()
+    block = bench_fn(*args)
+    if isinstance(block, dict):
+        block["control_plane"] = meter.block()
+    return block
+
+
+def _scale_definition(name):
+    """Device-light echo element: the scale storm measures the CONTROL
+    plane (broker matching, gateway routing, EC syncs), so the data
+    plane is one integer add per frame."""
+    return {
+        "name": name,
+        "parameters": {"telemetry": False,
+                       "gateway_policy": _SCALE_POLICY,
+                       "federation_policy":
+                           f"{_SCALE_FEDERATION};group=g0"},
+        "graph": ["(echo)"],
+        "elements": [
+            {"name": "echo",
+             "input": [{"name": "number", "type": "int"}],
+             "output": [{"name": "number", "type": "int"}],
+             "parameters": {"constant": 1},
+             "deploy": _local("PE_Add")},
+        ],
+    }
+
+
+def _scale_ab_arm(mode: str, subscriptions, messages):
+    """One trie-vs-linear A/B arm: a dedicated loopback broker in
+    `mode`, C clients with deterministic wildcard subscription sets,
+    K deterministic publishes.  Returns (per-client delivery lists,
+    mean per-message match seconds from the broker.match_s delta)."""
+    from aiko_services_tpu.observe.metrics import get_registry
+    from aiko_services_tpu.transport.loopback import (
+        LoopbackTransport, get_broker)
+
+    broker = get_broker(f"scale_ab_{mode}")
+    broker.match_mode = mode
+    clients = []
+    for patterns in subscriptions:
+        received = []
+        transport = LoopbackTransport(
+            on_message=(lambda topic, payload, received=received:
+                        received.append((topic, payload))),
+            broker=f"scale_ab_{mode}")
+        for pattern in patterns:
+            transport.subscribe(pattern)
+        transport.connect()
+        clients.append(received)
+    histogram = get_registry().histogram("broker.match_s")
+    count_before, sum_before = histogram.count, histogram.total
+    start = time.perf_counter()
+    for topic, payload in messages:
+        broker.publish(topic, payload)
+    broker.drain(timeout=60)
+    elapsed = max(time.perf_counter() - start, 1e-9)
+    matched = max(histogram.count - count_before, 1)
+    mean_match_s = (histogram.total - sum_before) / matched
+    return ([list(received) for received in clients], mean_match_s,
+            len(messages) / elapsed)
+
+
+def bench_scale(peak):
+    """`scale` config (ROADMAP #5): O(10k) lightweight open-loop
+    streams through a FEDERATED gateway tier -- multiple gateway
+    groups, streams assigned by consistent hash of stream id, one
+    shared device-light replica fleet -- with the broker and registrar
+    measured as the control-plane ceiling.  Publishes goodput / shed /
+    p99 (frames_lost must be 0: every offered frame answers exactly
+    once), the new `broker.*` counters (messages, matched-fanout
+    ratio, match latency), and a trie-vs-linear A/B arm proving the
+    broker match fast path is FASTER and delivery-identical (same
+    messages, same per-topic order)."""
+    import threading
+
+    import numpy as np
+
+    from aiko_services_tpu.observe.metrics import (
+        get_registry, snapshot_quantile)
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process
+    from aiko_services_tpu.serve import FederationRouter, Gateway
+    from aiko_services_tpu.transport import TopicTrie, topic_matches
+
+    streams_n = int(os.environ.get(
+        "AIKO_SCALE_STREAMS", "1500" if SMOKE else "6000"))
+    frames_per_stream = 2
+    groups = list(_SCALE_GROUPS[:2 if SMOKE else len(_SCALE_GROUPS)])
+    replicas_n = 2
+    offered = streams_n * frames_per_stream
+    # broker counters window: the WHOLE config (A/B arms included --
+    # the storm itself rides the in-process fast paths, so the arms
+    # supply the broker's own matching traffic)
+    registry = get_registry()
+    before = dict(registry.snapshot()["counters"])
+    match_before = registry.histogram("broker.match_s").snapshot()
+
+    # -- trie-vs-linear A/B (deterministic corpus, dedicated brokers) --
+    rng = random.Random(23)
+    corpus = ([f"t/{index}" for index in range(64)]
+              + [f"t/{index}/+" for index in range(16)]
+              + [f"grp/{index}/#" for index in range(16)]
+              + ["t/#", "+/0", "grp/+/state"])
+    subscriptions = [rng.sample(corpus, 6) for _ in range(48)]
+    topics = ([f"t/{rng.randrange(64)}" for _ in range(1500)]
+              + [f"grp/{rng.randrange(16)}/state" for _ in range(500)])
+    messages = [(topic, f"m{index}")
+                for index, topic in enumerate(topics)]
+    trie_deliveries, trie_match_s, trie_msgs_per_s = _scale_ab_arm(
+        "trie", subscriptions, messages)
+    linear_deliveries, linear_match_s, linear_msgs_per_s = (
+        _scale_ab_arm("linear", subscriptions, messages))
+    ab_identical = trie_deliveries == linear_deliveries
+    # direct matcher micro-bench over the same corpus: one trie walk
+    # vs the full linear pattern scan per message
+    flat = [(pattern, (client, pattern))
+            for client, patterns in enumerate(subscriptions)
+            for pattern in patterns]
+    trie = TopicTrie()
+    for pattern, value in flat:
+        trie.add(pattern, value)
+    start = time.perf_counter()
+    for topic, _ in messages:
+        trie.match(topic)
+    micro_trie_s = (time.perf_counter() - start) / len(messages)
+    start = time.perf_counter()
+    for topic, _ in messages:
+        [value for pattern, value in flat
+         if topic_matches(pattern, topic)]
+    micro_linear_s = (time.perf_counter() - start) / len(messages)
+
+    # -- the federated storm -------------------------------------------
+    processes, replicas = [], []
+    for index in range(replicas_n):
+        process = Process(transport_kind="loopback")
+        processes.append(process)
+        replicas.append(create_pipeline(
+            process, _scale_definition(f"scale_replica{index}")))
+    gateways = {}
+    for group in groups:
+        process = Process(transport_kind="loopback")
+        processes.append(process)
+        gateways[group] = Gateway(
+            process, name=f"gw_{group}", policy=_SCALE_POLICY,
+            federation=f"groups={','.join(groups)};group={group}",
+            telemetry=False)
+        for replica in replicas:
+            gateways[group].attach_replica(replica)
+    router = FederationRouter(gateways)
+    for process in processes:
+        process.run(in_thread=True)
+
+    responses = queue.Queue()
+    submit_times = {}
+    latencies = []
+    counts = {"ok": 0, "shed": 0, "overloaded": 0, "error": 0}
+    done = threading.Event()
+
+    def drain():
+        for _ in range(offered):
+            stream_id, frame_id, _outputs, status = responses.get(
+                timeout=900)
+            if status == "ok":
+                submitted = submit_times.pop((stream_id, frame_id),
+                                             None)
+                if submitted is not None:
+                    latencies.append(time.perf_counter() - submitted)
+            counts[status if status in counts else "error"] += 1
+        done.set()
+
+    start = time.perf_counter()
+    for index in range(streams_n):
+        router.submit_stream(f"s{index}", queue_response=responses,
+                             grace_time=1800)
+    drainer = threading.Thread(target=drain, daemon=True)
+    drainer.start()
+    # open loop: every frame submitted without waiting on completions
+    for frame_id in range(frames_per_stream):
+        for index in range(streams_n):
+            stream_id = f"s{index}"
+            submit_times[(stream_id, frame_id)] = time.perf_counter()
+            router.submit_frame(stream_id, {"number": index},
+                                frame_id=frame_id)
+    done.wait(timeout=900)
+    elapsed = time.perf_counter() - start
+    # streams are never destroyed mid-storm: the live count at drain
+    # time IS the concurrency the config claims
+    streams_live = sum(
+        len(gateway.streams) for gateway in gateways.values())
+    counters = registry.snapshot()["counters"]
+    match_after = registry.histogram("broker.match_s").snapshot()
+
+    def delta(name):
+        return counters.get(name, 0) - before.get(name, 0)
+
+    match_delta = {
+        "count": match_after["count"] - match_before["count"],
+        "sum": match_after["sum"] - match_before["sum"],
+        "min": match_after["min"], "max": match_after["max"],
+        "buckets": [late - early for late, early in zip(
+            match_after["buckets"], match_before["buckets"])],
+    }
+    delivered = delta("broker.fanout_delivered")
+    avoided = delta("broker.fanout_avoided")
+    shed = counts["shed"] + counts["overloaded"]
+    frames_lost = offered - counts["ok"] - shed - counts["error"]
+    for process in processes:
+        process.terminate()
+    return {
+        "streams": streams_n,
+        "streams_live_peak": streams_live,
+        "gateway_groups": len(groups),
+        "replicas": replicas_n,
+        "topology": (f"federated tier: {len(groups)} gateway groups "
+                     f"(consistent-hash stream->group) over one "
+                     f"shared {replicas_n}-replica fleet, loopback"),
+        "policy": _SCALE_POLICY,
+        "offered_frames": offered,
+        "completed": counts["ok"],
+        "shed": shed,
+        "errors": counts["error"],
+        "frames_lost": frames_lost,
+        "goodput_fps": round(counts["ok"] / max(elapsed, 1e-9), 1),
+        # subset-run headline alias: goodput IS the config's frame rate
+        "frames_per_sec_total": round(
+            counts["ok"] / max(elapsed, 1e-9), 1),
+        "p50_ms": (round(float(np.percentile(latencies, 50)) * 1000, 2)
+                   if latencies else None),
+        "p99_ms": (round(float(np.percentile(latencies, 99)) * 1000, 2)
+                   if latencies else None),
+        "broker": {
+            "messages": delta("broker.messages"),
+            "msgs_per_s": round(
+                delta("broker.messages") / max(elapsed, 1e-9), 1),
+            "matched_fanout_ratio": round(
+                delivered / max(delivered + avoided, 1), 4),
+            "fanout_avoided": avoided,
+            "match_p50_us": round(snapshot_quantile(
+                match_delta, 0.5) * 1e6, 2),
+            "match_p99_us": round(snapshot_quantile(
+                match_delta, 0.99) * 1e6, 2),
+        },
+        "trie_vs_linear": {
+            "ab_identical": ab_identical,
+            "clients": len(subscriptions),
+            "messages": len(messages),
+            "broker_match_trie_us": round(trie_match_s * 1e6, 3),
+            "broker_match_linear_us": round(linear_match_s * 1e6, 3),
+            "broker_trie_msgs_per_s": round(trie_msgs_per_s, 1),
+            "broker_linear_msgs_per_s": round(linear_msgs_per_s, 1),
+            "match_trie_us": round(micro_trie_s * 1e6, 3),
+            "match_linear_us": round(micro_linear_s * 1e6, 3),
+            "match_speedup": round(
+                micro_linear_s / max(micro_trie_s, 1e-12), 2),
+        },
+    }
+
+
 def bench_tts(peak):
     """Text -> speech through the pipeline element (chars -> mel ->
     Griffin-Lim, ONE jit per frame batch): the last model family's
@@ -3179,6 +3492,7 @@ def collect_definitions() -> dict:
              "dtype": "float32" if SMOKE else "bfloat16"}),
         "chaos": _chaos_definition("bench_chaos"),
         "chaos_decode": _chaos_decode_definition("bench_chaos_decode"),
+        "scale": _scale_definition("bench_scale"),
         "tts": _tts_definition(
             "hello" if SMOKE else
             "the quick brown fox jumps over the lazy dog",
@@ -3210,6 +3524,9 @@ _SUMMARY_FIELDS = (
     ("autoscale", "warm_vs_cold_speedup", "warm_speedup"),
     ("chaos", "frames_lost", "chaos_lost"),
     ("chaos", "takeover_ms", "takeover_ms"),
+    ("scale", "streams", "scale_streams"),
+    ("scale", "goodput_fps", "scale_goodput"),
+    ("scale", "frames_lost", "scale_lost"),
     ("tts", "mfu", "tts_mfu"),
     ("pipeline_multimodal", "mfu", "headline_mfu"),
     ("pipeline_multimodal", "audio_realtime_factor", "audio_rt"),
@@ -3312,17 +3629,17 @@ def main() -> None:
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
                        "longcontext,serving,continuous,chunked_prefill,"
-                       "spec_decode,disagg,autoscale,chaos,latency,tts,"
-                       "pipeline")
+                       "spec_decode,disagg,autoscale,chaos,latency,scale,"
+                       "tts,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
     if "text" in wanted:
-        configs["text"] = bench_text()
+        configs["text"] = _with_control_plane(bench_text)
     if "asr" in wanted:
-        configs["asr"] = bench_asr(peak)
+        configs["asr"] = _with_control_plane(bench_asr, peak)
     if "detector" in wanted:
-        configs["detector"] = bench_detector(peak)
+        configs["detector"] = _with_control_plane(bench_detector, peak)
     if "llm" in wanted:
         configs["llm"] = bench_llm(peak)
     if "llm_sharded" in wanted:
@@ -3332,7 +3649,7 @@ def main() -> None:
     if "longcontext" in wanted:
         configs["longcontext"] = bench_longcontext(peak)
     if "serving" in wanted:
-        configs["serving"] = bench_serving(peak)
+        configs["serving"] = _with_control_plane(bench_serving, peak)
     if "continuous" in wanted:
         configs["continuous"] = bench_continuous(peak)
     if "chunked_prefill" in wanted:
@@ -3340,22 +3657,27 @@ def main() -> None:
     if "spec_decode" in wanted:
         configs["spec_decode"] = bench_spec_decode(peak)
     if "disagg" in wanted:
-        configs["disagg"] = bench_disagg(peak)
+        configs["disagg"] = _with_control_plane(bench_disagg, peak)
     if router_replicas is not None or "router" in wanted:
-        configs["router"] = bench_router(peak, router_replicas or 2)
+        configs["router"] = _with_control_plane(
+            bench_router, peak, router_replicas or 2)
     if "autoscale" in wanted:
-        configs["autoscale"] = bench_autoscale(peak)
+        configs["autoscale"] = _with_control_plane(bench_autoscale, peak)
     if "chaos" in wanted:
-        configs["chaos"] = bench_chaos(peak)
+        configs["chaos"] = _with_control_plane(bench_chaos, peak)
     if "latency" in wanted:
-        configs["latency"] = bench_latency(peak)
+        configs["latency"] = _with_control_plane(bench_latency, peak)
+    if "scale" in wanted:
+        configs["scale"] = _with_control_plane(bench_scale, peak)
     if "tts" in wanted:
-        configs["tts"] = bench_tts(peak)
+        configs["tts"] = _with_control_plane(bench_tts, peak)
     headline_fps, headline_p50, audio_seconds = None, None, None
     headline_rows = 1
     if "pipeline" in wanted:
+        meter = _ControlPlaneMeter()
         (configs["pipeline_multimodal"], headline_fps, headline_p50,
          audio_seconds, headline_rows) = bench_multimodal(peak)
+        configs["pipeline_multimodal"]["control_plane"] = meter.block()
     metric = "multimodal_pipeline_frames_per_sec"
     unit = ("frames/sec end-to-end (3-stage speech+LM+vision graph, "
             "HBM-resident, 1 chip)")

@@ -30,6 +30,24 @@ def test_thousand_services_register_filter_and_reap():
     registrar = Registrar(registrar_process, search_timeout=0.05)
     registrar_process.run(in_thread=True)
 
+    # ISSUE 15 satellite: the registrar COALESCES its service_count
+    # share update (ECProducer.stage), so a registration storm emits
+    # O(ticks) share publishes -- not one per service.  An ECConsumer
+    # lease makes the publishes real (no lease, no wire traffic), and
+    # counts how many delta payloads actually carried the key.
+    observer_process = Process(transport_kind="loopback")
+    observer_process.run(in_thread=True)
+    mirror: dict = {}
+    from aiko_services_tpu.runtime.share import ECConsumer
+    consumer = ECConsumer(observer_process, mirror, registrar.topic_path,
+                          lease_time=300)
+    wait_for(lambda: consumer.synced, timeout=30)
+    count_publishes = [0]
+    consumer.add_change_handler(
+        lambda _c, command, name, value:
+        count_publishes.__setitem__(
+            0, count_publishes[0] + (name == "service_count")))
+
     worker = Process(transport_kind="loopback")
     start = time.perf_counter()
     actors = [Actor(worker, name=f"svc_{index:04d}")
@@ -56,13 +74,29 @@ def test_thousand_services_register_filter_and_reap():
         ServiceFilter(name="svc_0500")))
     assert len(exact) == 1 and exact[0].name == "svc_0500"
 
+    # coalescing proof: the storm's share publish count is O(mailbox
+    # drain cycles), not O(services) -- the EVENTUAL value is exact
+    # (the table also holds the registrar/observer services, so compare
+    # against the live table size) while the wire carried a small
+    # fraction of 1,000 updates
+    wait_for(lambda: str(mirror.get("service_count"))
+             == str(len(registrar.services_table)), timeout=30)
+    assert int(mirror["service_count"]) >= SERVICES
+    storm_publishes = count_publishes[0]
+    assert storm_publishes <= SERVICES // 10, (
+        f"registration storm published service_count {storm_publishes} "
+        f"times for {SERVICES} registrations -- coalescing regressed")
+
     # process death reaps EVERY worker service (LWT -> registrar purge)
     worker.terminate()
     get_broker().drain()
     wait_for(lambda: worker_count() == 0, timeout=30)
+    consumer.terminate()
+    observer_process.terminate()
     registrar_process.terminate()
     print(f"\n{SERVICES} services registered in {elapsed:.1f}s "
-          f"({SERVICES / elapsed:.0f}/s)")
+          f"({SERVICES / elapsed:.0f}/s); service_count publishes: "
+          f"{storm_publishes}")
 
 
 def test_hundred_process_instances_one_host():

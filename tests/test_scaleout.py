@@ -1,0 +1,514 @@
+# Ten-thousand-stream scale-out suite (ISSUE 15): the topic-trie broker
+# fast path (trie match set == the linear `topic_matches` scan, bit for
+# bit, over a generated corpus), sharded dispatch per-topic FIFO,
+# avoided-wakeup accounting, coalesced control-plane publishes
+# (ECProducer.stage delta folding), and the federated gateway tier
+# (consistent-hash stream -> group assignment, wrong_group fast-fail,
+# per-group journal namespacing, and a federated storm with zero lost
+# frames).
+
+import queue
+import random
+
+import pytest
+
+from aiko_services_tpu.observe.metrics import get_registry
+from aiko_services_tpu.pipeline import (
+    PipelineElement, StreamEvent, create_pipeline)
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.runtime.actor import Actor
+from aiko_services_tpu.runtime.share import ECConsumer
+from aiko_services_tpu.serve import (
+    FederationPolicy, FederationRouter, Gateway, assign_group)
+from aiko_services_tpu.transport import (
+    TopicTrie, get_broker, reset_brokers, topic_matches)
+from aiko_services_tpu.transport.loopback import (
+    LoopbackBroker, LoopbackTransport)
+from helpers import wait_for
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+# -- topic trie ==== linear topic_matches, property-style --------------------
+
+
+_SEGMENTS = ["a", "b", "c", "sensor", "x1", "", "state", "+"]
+_PATTERN_SEGMENTS = _SEGMENTS + ["#"]
+
+
+def _corpus(seed, topics_n=120, patterns_n=160):
+    rng = random.Random(seed)
+
+    def levels(source, count):
+        return "/".join(rng.choice(source) for _ in range(count))
+
+    topics = {levels(_SEGMENTS, rng.randint(1, 5))
+              for _ in range(topics_n)}
+    # edge cases the MQTT grammar defines precisely
+    topics |= {"a", "a/b", "a/b/c", "/a", "a/", "a//c", "+", "a/+"}
+    patterns = {levels(_PATTERN_SEGMENTS, rng.randint(1, 5))
+                for _ in range(patterns_n)}
+    patterns |= {"#", "+", "+/+", "a/#", "a/+/c", "/#", "/+", "a/#/b",
+                 "a/b", "a//c", "+/b/#"}
+    return sorted(topics), sorted(patterns)
+
+
+class TestTopicTrie:
+    def test_match_set_equals_linear_scan_bit_for_bit(self):
+        topics, patterns = _corpus(seed=7)
+        trie = TopicTrie()
+        for pattern in patterns:
+            trie.add(pattern, pattern)
+        assert len(trie) == len(patterns)
+        for topic in topics:
+            linear = {pattern for pattern in patterns
+                      if topic_matches(pattern, topic)}
+            assert set(trie.match(topic)) == linear, topic
+            assert trie.matches(topic) == bool(linear), topic
+
+    def test_discard_keeps_equivalence_under_churn(self):
+        topics, patterns = _corpus(seed=11)
+        rng = random.Random(3)
+        trie = TopicTrie()
+        live = set()
+        for pattern in patterns:
+            trie.add(pattern, pattern)
+            live.add(pattern)
+        for pattern in rng.sample(sorted(live), len(live) // 2):
+            trie.discard(pattern, pattern)
+            live.discard(pattern)
+        trie.discard("never/registered", "never/registered")  # no-op
+        assert len(trie) == len(live)
+        for topic in topics:
+            linear = {pattern for pattern in live
+                      if topic_matches(pattern, topic)}
+            assert set(trie.match(topic)) == linear, topic
+
+    def test_one_value_under_many_patterns_appears_once(self):
+        trie = TopicTrie()
+        trie.add("a/#", "client")
+        trie.add("a/+", "client")
+        trie.add("a/b", "client")
+        assert trie.match("a/b") == ["client"]
+
+    def test_remove_value_strips_every_registration(self):
+        trie = TopicTrie()
+        for pattern in ("a/#", "b/+", "c"):
+            trie.add(pattern, "dead")
+            trie.add(pattern, "alive")
+        trie.remove_value("dead")
+        assert len(trie) == 3
+        for topic in ("a/x", "b/y", "c"):
+            assert trie.match(topic) == ["alive"]
+
+
+# -- broker fast path --------------------------------------------------------
+
+
+class _Collector:
+    """Loopback client collecting (topic, payload) in arrival order."""
+
+    def __init__(self, broker_name, subscriptions):
+        self.received = []
+        self.transport = LoopbackTransport(
+            on_message=lambda topic, payload: self.received.append(
+                (topic, payload)),
+            broker=broker_name)
+        for pattern in subscriptions:
+            self.transport.subscribe(pattern)
+        self.transport.connect()
+
+
+class TestBrokerFastPath:
+    def test_trie_and_linear_arms_deliver_identically(self):
+        """The A/B contract the bench asserts: same messages, same
+        per-client order, whichever matcher routes them."""
+        rng = random.Random(5)
+        topics, patterns = _corpus(seed=19, topics_n=40, patterns_n=60)
+        subscriptions = [rng.sample(patterns, 4) for _ in range(12)]
+        messages = [(rng.choice(topics), f"m{index}")
+                    for index in range(300)]
+        deliveries = {}
+        for mode in ("trie", "linear"):
+            broker = get_broker(f"ab_{mode}")
+            broker.match_mode = mode
+            clients = [_Collector(f"ab_{mode}", subs)
+                       for subs in subscriptions]
+            for topic, payload in messages:
+                broker.publish(topic, payload)
+            broker.drain()
+            deliveries[mode] = [client.received for client in clients]
+        assert deliveries["trie"] == deliveries["linear"]
+        # and the fast path actually filtered: every delivery matched
+        for client_subs, received in zip(subscriptions,
+                                         deliveries["trie"]):
+            for topic, _ in received:
+                assert any(topic_matches(pattern, topic)
+                           for pattern in client_subs)
+
+    def test_fanout_avoided_counts_skipped_wakeups(self):
+        broker = get_broker("fanout")
+        listener = _Collector("fanout", ["wanted/topic"])
+        _bystanders = [_Collector("fanout", [f"other/{index}"])
+                       for index in range(3)]
+        avoided = get_registry().counter("broker.fanout_avoided")
+        delivered = get_registry().counter("broker.fanout_delivered")
+        avoided_before, delivered_before = avoided.value, delivered.value
+        broker.publish("wanted/topic", "hello")
+        broker.drain()
+        assert listener.received == [("wanted/topic", "hello")]
+        assert delivered.value - delivered_before == 1
+        # 3 bystanders with zero matching subscriptions never woke
+        assert avoided.value - avoided_before == 3
+
+    def test_sharded_dispatch_preserves_per_topic_fifo(self):
+        broker = LoopbackBroker("sharded", shards=4)
+        try:
+            received = []
+            client = LoopbackTransport(
+                on_message=lambda topic, payload: received.append(
+                    (topic, payload)))
+            client._broker_name = "unused"
+            client.subscribe("#")
+            # attach directly: this broker is not in the registry
+            client._broker = broker
+            client._connected = True
+            broker.attach(client)
+            topics = [f"stream/{index}" for index in range(8)]
+            for sequence in range(50):
+                for topic in topics:
+                    broker.publish(topic, str(sequence))
+            broker.drain()
+            assert len(received) == 8 * 50
+            per_topic = {}
+            for topic, payload in received:
+                per_topic.setdefault(topic, []).append(int(payload))
+            # same topic -> same shard -> FIFO preserved per topic
+            for topic in topics:
+                assert per_topic[topic] == list(range(50)), topic
+        finally:
+            broker.shutdown()
+
+    def test_partitioned_client_is_unrouted_until_heal(self):
+        broker = get_broker("part")
+        client = _Collector("part", ["t/#"])
+        broker.drain()
+        client.transport.partition()
+        broker.publish("t/1", "lost")
+        broker.drain()
+        assert client.received == []
+        client.transport.heal()
+        broker.publish("t/2", "seen")
+        broker.drain()
+        assert ("t/2", "seen") in client.received
+
+
+# -- process handler dispatch ------------------------------------------------
+
+
+class TestProcessHandlerTrie:
+    def test_wildcard_handlers_fire_in_registration_order(self):
+        process = Process(transport_kind="loopback")
+        calls = []
+        process.add_message_handler(
+            lambda topic, payload: calls.append("plus"), "ns/+/x")
+        process.add_message_handler(
+            lambda topic, payload: calls.append("hash"), "ns/a/#")
+        process.add_message_handler(
+            lambda topic, payload: calls.append("exact"), "ns/a/x")
+        process.run(in_thread=True)
+        process.publish("ns/a/x", "(ping)")
+        wait_for(lambda: len(calls) == 3)
+        assert calls == ["plus", "hash", "exact"]
+        process.publish("ns/b/x", "(ping)")
+        wait_for(lambda: len(calls) == 4)
+        assert calls[3] == "plus"
+        process.terminate()
+
+    def test_removed_handler_stops_matching(self):
+        process = Process(transport_kind="loopback")
+        calls = []
+
+        def handler(topic, payload):
+            calls.append(topic)
+
+        process.add_message_handler(handler, "gone/+")
+        process.remove_message_handler(handler, "gone/+")
+        process.add_message_handler(
+            lambda topic, payload: calls.append("kept"), "kept/topic")
+        process.run(in_thread=True)
+        process.publish("gone/x", "(ping)")
+        process.publish("kept/topic", "(ping)")
+        wait_for(lambda: calls)
+        assert calls == ["kept"]
+        process.terminate()
+
+
+# -- coalesced EC publishes --------------------------------------------------
+
+
+class _Bursty(Actor):
+    """Actor staging a burst of share updates in ONE mailbox turn."""
+
+    def burst(self, count):
+        for index in range(int(count)):
+            self.ec_producer.stage("x", index)
+
+    def stage_same(self, value):
+        self.ec_producer.stage("x", value)
+
+    def stage_then_update(self, staged, updated):
+        # an immediate update() must SUPERSEDE the pending staged
+        # value: the deferred flush must not later overwrite it
+        self.ec_producer.stage("x", staged)
+        self.ec_producer.update("x", updated)
+
+    def remove_then_restage(self, value):
+        # remove() drops the key on every consumer; re-staging the SAME
+        # scalar must still publish (the consumer mirror is empty)
+        self.ec_producer.remove("x")
+        self.ec_producer.stage("x", value)
+
+
+class TestCoalescedShare:
+    def _wire(self):
+        producer_process = Process(transport_kind="loopback")
+        actor = _Bursty(producer_process, name="bursty")
+        producer_process.run(in_thread=True)
+        consumer_process = Process(transport_kind="loopback")
+        consumer_process.run(in_thread=True)
+        cache = {}
+        consumer = ECConsumer(consumer_process, cache, actor.topic_path,
+                              lease_time=60)
+        wait_for(lambda: consumer.synced)
+        return producer_process, consumer_process, actor, cache, consumer
+
+    def test_burst_folds_into_one_delta(self):
+        producer_process, consumer_process, actor, cache, consumer = (
+            self._wire())
+        updates = []
+        consumer.add_change_handler(
+            lambda _c, command, name, value: updates.append(
+                (command, name, value)))
+        delta_publishes = get_registry().counter("share.delta_publishes")
+        before = delta_publishes.value
+        actor.post_message("burst", [100])
+        wait_for(lambda: cache.get("x") == "99")
+        # 100 staged updates -> ONE delta payload, final value only
+        assert delta_publishes.value - before == 1
+        assert [u for u in updates if u[1] == "x"] == [
+            ("update", "x", "99")]
+        producer_process.terminate()
+        consumer_process.terminate()
+
+    def test_update_supersedes_pending_staged_value(self):
+        producer_process, consumer_process, actor, cache, _ = self._wire()
+        actor.post_message("stage_then_update", [1, 2])
+        wait_for(lambda: cache.get("x") == "2")
+        # the deferred flush must NOT roll the mirror back to the
+        # staged 1; poke another key through a flush cycle and re-check
+        actor.post_message("burst", [0])
+        import time
+        time.sleep(0.2)
+        get_broker().drain()
+        assert cache.get("x") == "2"
+        producer_process.terminate()
+        consumer_process.terminate()
+
+    def test_remove_then_restage_same_value_republishes(self):
+        producer_process, consumer_process, actor, cache, _ = self._wire()
+        actor.post_message("stage_same", [9])
+        wait_for(lambda: cache.get("x") == "9")
+        actor.post_message("remove_then_restage", [9])
+        # consumers dropped the key on remove; the re-stage of the SAME
+        # scalar must republish it (the flushed-shadow was cleared)
+        wait_for(lambda: cache.get("x") == "9")
+        producer_process.terminate()
+        consumer_process.terminate()
+
+    def test_unchanged_scalar_restage_publishes_nothing(self):
+        producer_process, consumer_process, actor, cache, _ = self._wire()
+        delta_publishes = get_registry().counter("share.delta_publishes")
+        actor.post_message("stage_same", [7])
+        wait_for(lambda: cache.get("x") == "7")
+        flushed = delta_publishes.value
+        actor.post_message("stage_same", [7])     # identical value
+        actor.post_message("burst", [0])          # force a flush cycle
+        import time
+        time.sleep(0.2)
+        get_broker().drain()
+        assert delta_publishes.value == flushed
+        producer_process.terminate()
+        consumer_process.terminate()
+
+
+# -- federated gateway tier --------------------------------------------------
+
+
+class Echo(PipelineElement):
+    """Device-light element: the scale storm measures the CONTROL
+    plane, so the data plane is one integer add."""
+
+    def process_frame(self, stream, number):
+        return StreamEvent.OKAY, {"number": int(number) + 1}
+
+
+def _echo_definition(name):
+    return {
+        "name": name,
+        "parameters": {"telemetry": False},
+        "graph": ["(echo)"],
+        "elements": [
+            {"name": "echo", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "deploy": {"local": {"module": "tests.test_scaleout",
+                                  "class_name": "Echo"}}},
+        ],
+    }
+
+
+def _federated_tier(groups, replicas_n=2, policy="max_inflight=64;"
+                    "queue=4096", ha=False):
+    """One shared replica fleet fronted by one gateway per group.
+    Returns (router, gateways, replicas, processes)."""
+    processes, replicas = [], []
+    for index in range(replicas_n):
+        process = Process(transport_kind="loopback")
+        processes.append(process)
+        replicas.append(create_pipeline(
+            process, _echo_definition(f"replica{index}")))
+    spec = f"groups={','.join(groups)}"
+    gateways = {}
+    for group in groups:
+        process = Process(transport_kind="loopback")
+        processes.append(process)
+        gateways[group] = Gateway(
+            process, name=f"gw_{group}", policy=policy,
+            federation=f"{spec};group={group}",
+            ha=(group if ha else None),
+            telemetry=False)
+        for replica in replicas:
+            gateways[group].attach_replica(replica)
+    for process in processes:
+        process.run(in_thread=True)
+    return FederationRouter(gateways), gateways, replicas, processes
+
+
+class TestFederation:
+    def test_assign_group_is_deterministic_and_balanced(self):
+        groups = ("g0", "g1", "g2", "g3")
+        first = [assign_group(f"s{index}", groups) for index in range(2000)]
+        second = [assign_group(f"s{index}", groups)
+                  for index in range(2000)]
+        assert first == second
+        from collections import Counter
+        counts = Counter(first)
+        assert set(counts) == set(groups)
+        for group in groups:
+            assert 0.15 < counts[group] / 2000 < 0.35, counts
+
+    def test_consistent_hash_minimal_remap_on_group_loss(self):
+        """Removing one group only remaps ITS streams: every stream
+        owned by a surviving group keeps its assignment."""
+        groups = ("g0", "g1", "g2", "g3")
+        survivors = ("g0", "g1", "g2")
+        for index in range(500):
+            stream_id = f"s{index}"
+            before = assign_group(stream_id, groups)
+            after = assign_group(stream_id, survivors)
+            if before != "g3":
+                assert after == before, stream_id
+
+    def test_policy_parse_and_rejections(self):
+        policy = FederationPolicy.parse("groups=a,b,c;group=b")
+        assert policy.groups == ("a", "b", "c")
+        assert policy.group == "b"
+        assert policy.owner_of("s1") in policy.groups
+        with pytest.raises(ValueError):
+            FederationPolicy.parse("groups=")
+        with pytest.raises(ValueError):
+            FederationPolicy.parse("groups=a,a")
+        with pytest.raises(ValueError):
+            FederationPolicy.parse("groups=a;group=z")
+        with pytest.raises(ValueError, match="AIKO410"):
+            Gateway(Process(transport_kind="loopback"),
+                    federation="groups=a;group=z")
+
+    def test_wrong_group_stream_is_shed_typed(self):
+        router, gateways, _replicas, processes = _federated_tier(
+            ("g0", "g1"))
+        responses = queue.Queue()
+        # find a stream id owned by g1, submit it to g0 directly
+        stream_id = next(f"s{index}" for index in range(100)
+                         if router.group_for(f"s{index}") == "g1")
+        gateways["g0"].submit_stream(stream_id,
+                                     queue_response=responses)
+        reply = responses.get(timeout=10)
+        assert reply[3] == "overloaded"
+        assert reply[2]["reason"] == "wrong_group"
+        # routed through the router it lands on its OWN group and serves
+        router.submit_stream(stream_id, queue_response=responses)
+        router.submit_frame(stream_id, {"number": 41}, frame_id=0)
+        reply = responses.get(timeout=10)
+        assert reply[3] == "ok" and reply[2]["number"] == 42
+        for process in processes:
+            process.terminate()
+
+    def test_journals_namespace_per_group(self):
+        """HA + federation compose: each group's journal lives under
+        its own retained root, so a group's standby adopts exactly its
+        own streams."""
+        processes = []
+        roots = {}
+        for group in ("g0", "g1"):
+            process = Process(transport_kind="loopback")
+            processes.append(process)
+            process.run(in_thread=True)
+            gateway = Gateway(process, name=f"gw_{group}",
+                              federation=f"groups=g0,g1;group={group}",
+                              ha=group, telemetry=False)
+            assert gateway.federation_group == group
+            roots[group] = gateway.journal.backend.root_topic
+        assert roots["g0"] != roots["g1"]
+        assert "/gateway/g0/" in roots["g0"]
+        assert "/gateway/g1/" in roots["g1"]
+        for process in processes:
+            process.terminate()
+
+    def test_federated_storm_zero_lost_frames(self):
+        """The tier-1-sized scale storm: hundreds of open-loop streams
+        through a 2-group federated tier over a shared 2-replica
+        fleet -- every frame answers exactly once (ok or typed shed;
+        nothing lost), and ownership matches the consistent hash."""
+        streams_n, frames_per_stream = 300, 2
+        router, gateways, _replicas, processes = _federated_tier(
+            ("g0", "g1"))
+        responses = queue.Queue()
+        for index in range(streams_n):
+            router.submit_stream(f"s{index}", queue_response=responses,
+                                 grace_time=300)
+        for frame_id in range(frames_per_stream):
+            for index in range(streams_n):
+                router.submit_frame(f"s{index}",
+                                    {"number": index}, frame_id=frame_id)
+        outcomes = {"ok": 0, "shed": 0, "overloaded": 0, "error": 0}
+        for _ in range(streams_n * frames_per_stream):
+            reply = responses.get(timeout=60)
+            outcomes[reply[3]] += 1
+            if reply[3] == "ok":
+                assert reply[2]["number"] == int(
+                    reply[0][1:]) + 1
+        assert outcomes["ok"] == streams_n * frames_per_stream
+        assert outcomes["error"] == 0
+        # ownership: every stream landed on its consistent-hash group
+        for group, gateway in gateways.items():
+            for stream_id in gateway.streams:
+                assert router.group_for(stream_id) == group
+        assert sum(len(g.streams) for g in gateways.values()) == streams_n
+        for process in processes:
+            process.terminate()

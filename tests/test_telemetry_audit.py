@@ -18,8 +18,11 @@ PACKAGE = Path(__file__).resolve().parent.parent / "aiko_services_tpu"
 README = Path(__file__).resolve().parent.parent / "README.md"
 
 # the layers the audit covers (ISSUE 14: serve/, decode/, pipeline/ --
-# observe/ itself included since it defines the shared instruments)
-SCANNED_DIRS = ("serve", "decode", "pipeline", "observe")
+# observe/ itself included since it defines the shared instruments;
+# ISSUE 15 added transport/ + runtime/ so the broker.* / share.* /
+# registrar.* control-plane instruments are enforced too)
+SCANNED_DIRS = ("serve", "decode", "pipeline", "observe", "transport",
+                "runtime")
 
 _METHODS = {"counter", "gauge", "histogram"}
 
