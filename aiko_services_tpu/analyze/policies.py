@@ -17,9 +17,10 @@ __all__ = ["run_policy_pass", "check_gateway_policy",
            "check_autoscale_policy", "check_checkpoint_policy",
            "check_disagg_policy", "check_faults_spec",
            "check_federation_policy", "check_journal_policy",
-           "check_decode_parameters", "check_tune_spec",
-           "parse_speculative_spec", "FAULT_TOLERANCE_FIELDS",
-           "DECODE_FIELDS", "DISAGG_FIELDS", "SPECULATIVE_FIELDS"]
+           "check_decode_parameters", "check_prefix_policy",
+           "check_tune_spec", "parse_speculative_spec",
+           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS", "DISAGG_FIELDS",
+           "SPECULATIVE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -141,6 +142,15 @@ def check_decode_parameters(parameters: dict,
             problems.extend(checkpoint_problems)
             if not checkpoint_problems:
                 clean["checkpoint"] = parameters["checkpoint"]
+        if "prefix_policy" in parameters:
+            # the cross-request prefix-reuse spec (decode/prefix.py) is
+            # engine-scoped here: affinity_weight belongs on the
+            # gateway's `prefix` / the definition-level `prefix_policy`
+            prefix_problems = check_prefix_policy(
+                parameters["prefix_policy"], element=True)
+            problems.extend(prefix_problems)
+            if not prefix_problems:
+                clean["prefix_policy"] = parameters["prefix_policy"]
     if "speculative" in clean:
         try:
             parse_speculative_spec(clean["speculative"])
@@ -190,6 +200,17 @@ def check_decode_parameters(parameters: dict,
                 "AIKO409",
                 "checkpoint requires continuous=true (snapshots ride "
                 "the slot engine)"))
+    if "prefix_policy" in clean:
+        if role == "prefill":
+            problems.append((
+                "AIKO411",
+                "role=prefill exports its KV per handoff, not into a "
+                "slot pool; drop prefix_policy"))
+        elif not clean.get("continuous"):
+            problems.append((
+                "AIKO411",
+                "prefix_policy requires continuous=true (the cache "
+                "indexes the slot engine's paged pool)"))
     if problems or not clean.get("continuous"):
         return problems
     block_size = clean.get("kv_block_size", 16)
@@ -336,6 +357,28 @@ def check_autoscale_policy(spec) -> list:
     return problems
 
 
+def check_prefix_policy(spec, element: bool = False) -> list:
+    """(code, message) problems in a cross-request prefix-reuse spec
+    (rule code AIKO411).  Same shape as check_checkpoint_policy: the
+    per-directive grammar check, then the REAL PrefixPolicy.parse plus
+    its scope validation -- `affinity_weight` is gateway-side (routing
+    score), `min_prefix_blocks`/`cache_blocks` are engine-side (cache
+    shape) -- so a spec on the wrong side fails offline exactly as at
+    construction."""
+    from ..decode.prefix import PREFIX_GRAMMAR, PrefixPolicy
+    problems = PREFIX_GRAMMAR.check(spec, value_code="AIKO411")
+    if not problems:
+        try:
+            policy = PrefixPolicy.parse(spec)
+            if element:
+                policy.validate_engine()
+            else:
+                policy.validate_gateway()
+        except ValueError as error:
+            problems.append(("AIKO411", str(error)))
+    return problems
+
+
 def check_federation_policy(spec) -> list:
     """(code, message) problems in a federated-gateway spec.  Same
     shape as check_gateway_policy: the per-directive grammar check as
@@ -380,7 +423,8 @@ def run_policy_pass(definition) -> AnalysisReport:
             and (element.deploy_local or {}).get("class_name")
             == "LMGenerate")
         triggers = (tuple(DECODE_FIELDS)
-                    + ((tuple(DISAGG_FIELDS) + ("checkpoint",))
+                    + ((tuple(DISAGG_FIELDS)
+                        + ("checkpoint", "prefix_policy"))
                        if disagg_scope else ()))
         if any(key in parameters for key in triggers):
             for code, message in check_decode_parameters(
@@ -442,6 +486,14 @@ def run_policy_pass(definition) -> AnalysisReport:
         "federation_policy")
     if federation_spec:
         for code, message in check_federation_policy(federation_spec):
+            report.add(Diagnostic(code, message, definition=name))
+    # DEFINITION-level `prefix_policy` is the gateway-side affinity
+    # spec embedded next to the definition; element-level
+    # `prefix_policy` specs were checked engine-scoped through
+    # check_decode_parameters above (same split as checkpoint)
+    prefix_spec = (definition.parameters or {}).get("prefix_policy")
+    if prefix_spec:
+        for code, message in check_prefix_policy(prefix_spec):
             report.add(Diagnostic(code, message, definition=name))
     tune_spec = (definition.parameters or {}).get("tune")
     if tune_spec:

@@ -17,6 +17,11 @@
 #               CheckpointKeeper so a crashed replica's streams
 #               restore on a survivor (DecodeEngine.restore_request)
 #               instead of re-prefilling; AIKO409 policy grammar
+#   prefix.py   cross-request prefix KV reuse -- PrefixCache indexes
+#               fully-written prompt blocks by token hash chain so
+#               later admissions borrow the shared prefix (COW,
+#               refcounted, LRU second-chance eviction) and only
+#               tail-prefill the uncached rest; AIKO411 policy grammar
 #
 # Device kernels live in models/transformer.py (init_paged_pool,
 # paged_prefill, paged_prefill_chunk, paged_decode_step,
@@ -31,10 +36,14 @@ from .disagg import (                              # noqa: F401
 from .checkpoint import (                          # noqa: F401
     CHECKPOINT_SCHEMA, CheckpointKeeper, CheckpointPolicy,
     DecodeCheckpointer, get_keeper, register_keeper, reset_keepers)
+from .prefix import (                              # noqa: F401
+    PREFIX_GRAMMAR, PrefixCache, PrefixPolicy, chain_hashes,
+    prefix_head)
 
 __all__ = ["BlockManager", "TRASH_BLOCK", "CHECKPOINT_SCHEMA",
            "CheckpointKeeper", "CheckpointPolicy", "Completion",
            "DecodeCheckpointer", "DecodeEngine", "HANDOFF_SCHEMA",
-           "PrefillEngine", "StepReport", "fetch_kv_blocks",
-           "get_keeper", "offer_pool_blocks", "register_keeper",
-           "reset_keepers"]
+           "PREFIX_GRAMMAR", "PrefillEngine", "PrefixCache",
+           "PrefixPolicy", "StepReport", "chain_hashes",
+           "fetch_kv_blocks", "get_keeper", "offer_pool_blocks",
+           "prefix_head", "register_keeper", "reset_keepers"]

@@ -37,8 +37,12 @@ class BlockManager:
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         # LIFO free list: recently-freed blocks are re-used first (their
-        # pool pages are the warmest)
+        # pool pages are the warmest).  The parallel set exists only for
+        # O(1) double-free detection -- under prefix-cache churn a
+        # release wave frees hundreds of blocks, and the old
+        # `block in self._free` linear scan made each wave O(n^2)
         self._free = list(range(self.num_blocks - 1, TRASH_BLOCK, -1))
+        self._free_set = set(self._free)
 
     @property
     def free_count(self) -> int:
@@ -62,6 +66,7 @@ class BlockManager:
             return None
         taken = self._free[-count:] if count else []
         del self._free[len(self._free) - count:]
+        self._free_set.difference_update(taken)
         return taken
 
     def free(self, blocks) -> None:
@@ -69,6 +74,8 @@ class BlockManager:
             block = int(block)
             if block == TRASH_BLOCK:
                 raise ValueError("the trash block is never allocated")
-            if block in self._free or not (0 < block < self.num_blocks):
+            if block in self._free_set \
+                    or not (0 < block < self.num_blocks):
                 raise ValueError(f"double free / bad block {block}")
             self._free.append(block)
+            self._free_set.add(block)

@@ -63,6 +63,7 @@ from ..models import (
 from ..utils import get_logger
 from ..utils.padding import bucket_length
 from .blocks import TRASH_BLOCK, BlockManager
+from .prefix import PrefixCache, PrefixPolicy, chain_hashes
 
 __all__ = ["DecodeEngine", "Completion", "StepReport"]
 
@@ -103,7 +104,8 @@ class StepReport:
 
 class _Slot:
     __slots__ = ("request", "blocks", "seq", "true_len", "bucket",
-                 "padded", "prefill_pos", "draft_pending")
+                 "padded", "prefill_pos", "draft_pending", "shared",
+                 "hashes")
 
     def __init__(self, request: _Request, blocks: list, seq: int,
                  true_len: int, bucket: int, padded: np.ndarray):
@@ -115,6 +117,8 @@ class _Slot:
         self.padded = padded      # (bucket,) right-padded prompt
         self.prefill_pos = 0      # prompt tokens already written
         self.draft_pending = []   # emitted tokens the draft hasn't seen
+        self.shared = 0           # leading blocks borrowed from the
+        self.hashes = None        # prefix cache, + their digest chain
 
     @property
     def prefilling(self) -> bool:
@@ -143,7 +147,7 @@ class DecodeEngine:
                  max_context: int | None = None, eos_id: int | None = None,
                  prefill_chunk_size: int | None = None,
                  draft_params=None, draft_config=None, spec_k: int = 0,
-                 registry=None):
+                 prefix_policy=None, registry=None):
         if decode_slots < 1:
             raise ValueError(f"decode_slots must be >= 1, "
                              f"got {decode_slots}")
@@ -159,6 +163,18 @@ class DecodeEngine:
             # preemption never fires; shrink kv_blocks to oversubscribe
             kv_blocks = self.slots_n * self.max_blocks + 1
         self.blocks = BlockManager(int(kv_blocks), int(kv_block_size))
+        # cross-request prefix KV reuse (decode/prefix.py): with a
+        # prefix policy armed, fully-written prompt blocks are indexed
+        # by their token hash chain and later admissions borrow the
+        # longest cached prefix instead of re-prefilling it.  None =
+        # cold path, behavior identical to pre-prefix deployments
+        policy = (PrefixPolicy.parse(prefix_policy)
+                  if prefix_policy is not None else None)
+        if policy is not None and not policy.enabled:
+            policy = None
+        self.prefix_policy = policy
+        self.prefix = (PrefixCache(self.blocks, policy.cache_blocks)
+                       if policy is not None else None)
         self.pool = init_paged_pool(config, self.blocks.num_blocks,
                                     self.blocks.block_size)
         self.tables = np.full((self.slots_n, self.max_blocks),
@@ -220,7 +236,10 @@ class DecodeEngine:
                          "adopted": 0, "adopt_fallbacks": 0,
                          "kv_migrated_bytes": 0, "restores": 0,
                          "restore_fallbacks": 0,
-                         "restore_replayed_tokens": 0}
+                         "restore_replayed_tokens": 0,
+                         "prefix_hits": 0, "prefix_partial_hits": 0,
+                         "prefix_blocks_shared": 0,
+                         "prefix_evictions": 0}
         self._update_gauges()
 
     # -- submission --------------------------------------------------------
@@ -269,7 +288,7 @@ class DecodeEngine:
         runs with the reason, and (None, 0) comes back."""
         from .disagg import fetch_kv_blocks
 
-        granted = self.blocks.allocate(needed)
+        granted = self._allocate(needed)
         if granted is None:
             fallback("pool exhausted")
             return None, 0
@@ -643,26 +662,56 @@ class DecodeEngine:
             true_len = int(request.prompt.size)
             bucket = self._bucket(true_len)
             needed = self.blocks.blocks_for(bucket)
-            granted = self.blocks.allocate(needed)
+            # prefix-cache hit path: borrow the longest cached run of
+            # this prompt's hash chain, capped so the LAST prompt token
+            # always tail-prefills (its logits produce the first
+            # generated token), and only allocate the uncached rest
+            matched, hashes = [], None
+            if self.prefix is not None:
+                hashes = chain_hashes(request.prompt,
+                                      self.blocks.block_size)
+                usable = (true_len - 1) // self.blocks.block_size
+                matched = self.prefix.acquire(hashes[:usable])
+                if matched and (len(matched)
+                                < self.prefix_policy.min_prefix_blocks):
+                    # a tiny hit pays table-rewrite cost for nothing
+                    self.prefix.release(matched)
+                    matched = []
+            granted = self._allocate(needed - len(matched))
             if granted is None:
-                # pool exhausted: admission DEFERS (FIFO order kept);
-                # completions free blocks, so the queue always drains.
-                # Counted once per REQUEST, not per blocked tick.
+                # pool exhausted (cached tier already reclaimed):
+                # admission DEFERS (FIFO order kept); completions free
+                # blocks, so the queue always drains.  Counted once
+                # per REQUEST, not per blocked tick.
+                if matched:
+                    self.prefix.release(matched)
                 if not request.deferred:
                     request.deferred = True
                     self.counters["deferred_admissions"] += 1
                     self._bump("decode.deferred_admissions", 1)
                 return
+            blocks = list(matched) + granted
             self.waiting.popleft()
             index = free[0]
             padded = np.zeros((bucket,), np.int32)
             padded[:true_len] = request.prompt
-            slot = _Slot(request, granted, self._admission_seq, true_len,
+            slot = _Slot(request, blocks, self._admission_seq, true_len,
                          bucket, padded)
+            slot.shared = len(matched)
+            slot.hashes = hashes
+            slot.prefill_pos = len(matched) * self.blocks.block_size
             self._admission_seq += 1
             self.slots[index] = slot
             self.tables[index, :] = TRASH_BLOCK
-            self.tables[index, :needed] = granted
+            self.tables[index, :needed] = blocks
+            if matched:
+                self.counters["prefix_hits"] += 1
+                self.counters["prefix_blocks_shared"] += len(matched)
+                self._bump("decode.prefix_hits", 1)
+                self._bump("decode.prefix_blocks_shared", len(matched))
+                if len(matched) < usable:
+                    self.counters["prefix_partial_hits"] += 1
+                    self._bump("decode.prefix_partial_hits", 1)
             # a preempted request's RE-admission keeps first-attempt
             # timestamps: the caller saw its first token back then, so
             # ttft/queue_wait/prefill stats must not absorb the retry
@@ -676,6 +725,14 @@ class DecodeEngine:
                 # chunked: no device work at admission -- the slot's
                 # prompt is consumed one chunk per tick by
                 # _advance_prefills, interleaved with decode steps
+                # (a prefix hit just starts the chunk cursor past the
+                # borrowed blocks)
+                continue
+            if slot.shared:
+                # prefix hit on the monolithic path: ONE chunk call
+                # covers the uncached tail -- the whole point of the
+                # cache is skipping the quadratic prefix compute
+                self._tail_prefill(index, report)
                 continue
             before = _jit_cache_size()
             self.pool, first = paged_prefill(
@@ -685,15 +742,51 @@ class DecodeEngine:
             slot.prefill_pos = bucket
             self._finish_prefill(index, report, int(first))
 
+    def _tail_prefill(self, index: int, report: StepReport) -> None:
+        """Prefill ONLY the uncached tail of a prefix-cache hit in one
+        chunk call: paged_prefill_chunk attends to the borrowed
+        blocks' resident KV exactly as it attends to earlier chunks'
+        writes, so the produced logits -- and the first generated
+        token -- are bit-identical to a cold prefill over the whole
+        prompt (f32 and int8 KV alike; int8 per-block scales travel
+        with the shared blocks)."""
+        slot = self.slots[index]
+        block_size = self.blocks.block_size
+        start = slot.prefill_pos
+        remaining = slot.true_len - start
+        size = bucket_length(remaining, minimum=block_size)
+        chunk = np.zeros((1, size), np.int32)
+        chunk[0, :remaining] = slot.padded[start:start + remaining]
+        write_blocks = np.full((size,), TRASH_BLOCK, np.int32)
+        write_offsets = np.zeros((size,), np.int32)
+        for offset in range(size):
+            position = start + offset
+            if position < slot.true_len:
+                write_blocks[offset] = slot.blocks[
+                    position // block_size]
+            write_offsets[offset] = position % block_size
+        before = _jit_cache_size()
+        self.pool, greedy = paged_prefill_chunk(
+            self.params, self.config, self.pool, chunk,
+            self.tables[index], np.int32(start), write_blocks,
+            write_offsets)
+        self._note_compiles(_jit_cache_size() - before)
+        first = int(np.asarray(greedy)[slot.true_len - 1 - start])
+        self._finish_prefill(index, report, first)
+
     def _finish_prefill(self, index: int, report: StepReport,
                         first: int, draft_ready: bool = False) -> None:
         """Shared tail of monolithic and chunked prefill: record the
-        first generated token, arm the decode cursor, and bring the
-        speculative draft up to date with the prompt (chunked prefill
-        already fed the draft chunk-by-chunk: draft_ready=True)."""
+        first generated token, arm the decode cursor, register the
+        slot's freshly written prompt blocks with the prefix cache,
+        and bring the speculative draft up to date with the prompt
+        (chunked prefill already fed the draft chunk-by-chunk:
+        draft_ready=True)."""
         slot = self.slots[index]
         request = slot.request
         slot.prefill_pos = max(slot.prefill_pos, slot.true_len)
+        if self.prefix is not None:
+            self._register_slot_prefix(slot)
         if request.first_token_at is None:
             request.first_token_at = time.perf_counter()
         request.generated.append(first)
@@ -757,12 +850,18 @@ class DecodeEngine:
         write_blocks = np.full((size,), TRASH_BLOCK, np.int32)
         draft_blocks = np.full((size,), TRASH_BLOCK, np.int32)
         write_offsets = np.zeros((size,), np.int32)
+        # a prefix-hit slot's draft cache is missing the borrowed
+        # blocks' positions entirely, so chunk-feeding the draft would
+        # build on garbage: skip it and let _finish_prefill rebuild
+        # the draft monolithically (proposals are only proposals, but
+        # they should not be noise)
+        feed_draft = self.draft_params is not None and not slot.shared
         for offset in range(size):
             position = start + offset
             if position < slot.true_len:
                 block_index = position // block_size
                 write_blocks[offset] = slot.blocks[block_index]
-                if self.draft_params is not None:
+                if feed_draft:
                     draft_blocks[offset] = self.draft_tables[
                         index, block_index]
             write_offsets[offset] = position % block_size
@@ -771,7 +870,7 @@ class DecodeEngine:
             self.params, self.config, self.pool, chunk,
             self.tables[index], np.int32(start), write_blocks,
             write_offsets)
-        if self.draft_params is not None:
+        if feed_draft:
             self.draft_pool, _ = paged_prefill_chunk(
                 self.draft_params, self.draft_config, self.draft_pool,
                 chunk, self.draft_tables[index], np.int32(start),
@@ -782,9 +881,10 @@ class DecodeEngine:
         slot.prefill_pos = start + take
         if not slot.prefilling:
             first = int(np.asarray(greedy)[slot.true_len - 1 - start])
-            if self.draft_params is not None:
+            if feed_draft:
                 self.draft_positions[index] = slot.true_len
-            self._finish_prefill(index, report, first, draft_ready=True)
+            self._finish_prefill(index, report, first,
+                                 draft_ready=not slot.shared)
         return True
 
     # -- speculative decoding ----------------------------------------------
@@ -952,7 +1052,10 @@ class DecodeEngine:
                          self.max_context - 1)
             needed = (target // self.blocks.block_size) + 1
             while len(slot.blocks) < needed:
-                granted = self.blocks.allocate(1)
+                # cache-aware: the refcount-0 cached tier is reclaimed
+                # (LRU-first) BEFORE any preemption fires -- the cache
+                # must never cost a live request its slot
+                granted = self._allocate(1)
                 if granted is not None:
                     slot.blocks.extend(granted)
                     self.tables[index, len(slot.blocks) - 1] = granted[0]
@@ -988,11 +1091,133 @@ class DecodeEngine:
 
     def _release_slot(self, index: int) -> None:
         slot = self.slots[index]
-        self.blocks.free(slot.blocks)
+        if self.prefix is not None:
+            # registered blocks decref (a block another slot still
+            # shares is NEVER freed here -- preempting one holder must
+            # not corrupt its sibling); refcount-0 blocks park in the
+            # cached tier, private tail blocks free immediately
+            self.prefix.release(slot.blocks)
+        else:
+            self.blocks.free(slot.blocks)
         self.slots[index] = None
         self.tables[index, :] = TRASH_BLOCK
         self.positions[index] = 0
         self.last_tokens[index, 0] = 0
+
+    # -- prefix cache ------------------------------------------------------
+
+    def _allocate(self, count: int):
+        """Pool allocation through the prefix cache's second-chance
+        reclaim when the cache is armed: refcount-0 cached blocks are
+        evicted LRU-first BEFORE an allocation fails, so admission
+        deferral and the preemption ladder only ever fire for demand
+        the cold system could not have satisfied either."""
+        if self.prefix is not None:
+            return self.prefix.allocate(count)
+        return self.blocks.allocate(count)
+
+    def _register_slot_prefix(self, slot: _Slot) -> None:
+        """Index a slot's fully-written PROMPT blocks by their chain
+        digests.  Only blocks entirely below true_len are prompt-pure
+        (decode writes start AT true_len, so the block holding it is
+        mutable); blocks the slot itself borrowed are already
+        registered and are skipped via the depth offset."""
+        if slot.hashes is None:
+            slot.hashes = chain_hashes(slot.request.prompt,
+                                       self.blocks.block_size)
+        full = slot.true_len // self.blocks.block_size
+        if full > slot.shared:
+            self.prefix.register(slot.hashes[slot.shared:full],
+                                 slot.blocks[slot.shared:full],
+                                 depth=slot.shared)
+
+    def prefix_heads(self) -> list:
+        """Resident chain-head digests -- the compact summary a
+        replica mirrors into its EC share for gateway prefix-affinity
+        routing.  Empty when the cache is disarmed."""
+        if self.prefix is None:
+            return []
+        return self.prefix.heads()
+
+    def export_prefix_snapshot(self, tokens) -> dict | None:
+        """Package the resident cached prefix of `tokens` as a
+        checkpoint-keeper snapshot (decode/checkpoint.py schema), so
+        the keeper doubles as a second-chance CROSS-REPLICA prefix
+        store: another replica's adopt_prefix() pulls the blocks over
+        the transfer plane instead of re-prefilling.  Returns None
+        when the cache is disarmed or holds no block of this chain.
+
+        Keyed ("prefix", head-digest) -- digests are process-stable,
+        so any replica that computes the same chain finds it.  seq=0
+        every time: a prefix snapshot is always a full (non-delta)
+        incarnation."""
+        if self.prefix is None:
+            return None
+        from .checkpoint import CHECKPOINT_SCHEMA
+        from .disagg import offer_pool_blocks
+
+        hashes = chain_hashes(tokens, self.blocks.block_size)
+        blocks = self.prefix.resident_blocks(hashes)
+        if not blocks:
+            return None
+        kv_blocks, _total = offer_pool_blocks(self.pool, blocks)
+        count = len(blocks)
+        size = self.blocks.block_size
+        prefix_tokens = np.asarray(tokens, np.int32).reshape(-1)
+        return {
+            "schema": CHECKPOINT_SCHEMA,
+            "request_id": ["prefix", hashes[0]],
+            "prompt": [int(token) for token
+                       in prefix_tokens[:count * size]],
+            "generated": [],
+            "emitted_upto": 0,
+            "max_new": 0,
+            "true_len": count * size,
+            "position": count * size,
+            "block_size": size,
+            "kv_dtype": self.config.kv_dtype or "",
+            "blocks_total": count,
+            "delta_from": 0,
+            "seq": 0,
+            "kv_blocks": kv_blocks,
+        }
+
+    def adopt_prefix(self, record: dict,
+                     timeout: float | None = None) -> int:
+        """Ingest a keeper prefix record into the LOCAL cache: fetch
+        the KV blocks over the transfer plane (the same consumer half
+        prefill handoff and checkpoint restore use) and register them
+        at refcount 0 -- straight into the reclaimable cached tier, so
+        an imported prefix can never pin pool capacity a live request
+        needs.  Returns the number of blocks registered (0 on any
+        failure or when the chain is already resident: pre-warming is
+        best-effort by design)."""
+        if self.prefix is None:
+            return 0
+        if int(record.get("block_size", 0)) != self.blocks.block_size:
+            return 0
+        prompt = np.asarray(record.get("prompt", ()),
+                            np.int32).reshape(-1)
+        hashes = chain_hashes(prompt, self.blocks.block_size)
+        needed = len(record.get("kv_blocks") or [])
+        if not hashes or needed != len(hashes):
+            return 0
+        if self.prefix.lookup(hashes) == len(hashes):
+            return 0                  # already fully resident
+
+        def fallback(reason: str) -> None:
+            _LOGGER.info("prefix adopt skipped: %s", reason)
+
+        granted, migrated = self._ingest_kv_blocks(
+            record, needed, timeout, fallback, "prefix")
+        if granted is None:
+            return 0
+        indexed = self.prefix.register(hashes, granted, depth=0,
+                                       refcount=0)
+        self.counters["kv_migrated_bytes"] += migrated
+        self._bump("decode.kv_migrated_bytes", migrated)
+        self._update_gauges()
+        return len(indexed)
 
     # -- completion --------------------------------------------------------
 
@@ -1030,6 +1255,11 @@ class DecodeEngine:
             "total_s": now - request.submitted_at,
             "tokens": len(request.generated),
         }
+        if self.prefix is not None:
+            # rides the completion row into the engine trace span
+            # (observe/telemetry.py) so `aiko tune` can tell a
+            # cache-bound prefill floor from a compute-bound one
+            stats["prefix_blocks"] = slot.shared
         if self._registry is not None:
             self._registry.histogram("decode.queue_wait_s").record(
                 stats["queue_wait_s"])
@@ -1062,6 +1292,15 @@ class DecodeEngine:
             self._registry.counter(name).inc(amount)
 
     def _update_gauges(self) -> None:
+        if self.prefix is not None:
+            # the cache owns the eviction count (reclaims happen inside
+            # PrefixCache.allocate/_trim); sync the engine counter here
+            # so stats()/telemetry see one authoritative number
+            delta = (self.prefix.evictions
+                     - self.counters["prefix_evictions"])
+            if delta > 0:
+                self.counters["prefix_evictions"] += delta
+                self._bump("decode.prefix_evictions", delta)
         if self._registry is None:
             return
         self._registry.gauge("decode.active_slots").set(
@@ -1069,6 +1308,9 @@ class DecodeEngine:
         self._registry.gauge("decode.free_blocks").set(
             self.blocks.free_count)
         self._registry.gauge("decode.waiting").set(len(self.waiting))
+        if self.prefix is not None:
+            self._registry.gauge("decode.prefix_cached_blocks").set(
+                self.prefix.cached_count)
 
     def stats(self) -> dict:
         stats = {
@@ -1083,6 +1325,9 @@ class DecodeEngine:
         }
         if self.prefill_chunk is not None:
             stats["prefill_chunk_size"] = self.prefill_chunk
+        if self.prefix is not None:
+            stats["prefix_cached_blocks"] = self.prefix.cached_count
+            stats["prefix_shared_blocks"] = self.prefix.shared_count
         if self.draft_params is not None:
             windows = max(self.counters["spec_windows"], 1)
             spec_total = self.spec_draft_s + self.spec_verify_s

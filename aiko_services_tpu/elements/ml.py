@@ -428,6 +428,16 @@ class LMGenerate(ComputeElement):
         eos_id = self.get_parameter("eos_id")
         prefill_chunk = self.get_parameter("prefill_chunk_size")
         draft_params, draft_config, spec_k = self._speculative_setup()
+        prefix_spec = self.get_parameter("prefix_policy")
+        prefix_policy = None
+        if prefix_spec:
+            # cross-request prefix KV reuse (decode/prefix.py): the
+            # spec parses through the AIKO411 grammar AS-IS (string or
+            # dict, same value lint checked) -- a bad value fails here
+            # with the same message `aiko lint` reports
+            from ..decode.prefix import PrefixPolicy
+            prefix_policy = PrefixPolicy.parse(prefix_spec)
+            prefix_policy.validate_engine()
         self._engine = DecodeEngine(
             self.state, self.config,
             decode_slots=int(self.get_parameter("decode_slots", 4)),
@@ -439,7 +449,9 @@ class LMGenerate(ComputeElement):
                                 else None),
             draft_params=draft_params, draft_config=draft_config,
             spec_k=spec_k,
+            prefix_policy=prefix_policy,
             registry=registry)
+        self._prefix_heads_shared = ""
         self._engine_frames = {}
         self._pump_posted = False
         self._checkpointer = None
@@ -678,6 +690,13 @@ class LMGenerate(ComputeElement):
             "chunk": max(1, int(self.get_parameter(
                 "stream_chunk", 8, stream))),
             "buffers": {},
+            # cross-replica prefix store (decode/prefix.py): the
+            # gateway injects `prefix_keeper` when it runs both a
+            # checkpoint keeper and a prefix policy; prompts are kept
+            # so finished requests can export their cached prefix
+            "prefix_keeper": str(self.get_parameter(
+                "prefix_keeper", "", stream) or ""),
+            "prompts": None,
         }
         # submission order == row order; the engine's FIFO admission
         # keeps caller-observed ordering deterministic.  A rejected row
@@ -713,6 +732,9 @@ class LMGenerate(ComputeElement):
                 self._restore_rows(stream, key, tokens, max_new,
                                    restore)
             else:
+                if engine.prefix is not None:
+                    self._engine_frames[key]["prompts"] = tokens
+                    self._prewarm_prefix(stream, tokens)
                 for row in range(rows):
                     engine.submit(key + (row,), tokens[row], max_new)
         except ValueError:
@@ -800,6 +822,71 @@ class LMGenerate(ComputeElement):
             self.pipeline.streams.get(key[0]), key[1],
             self.definition.name, elapsed_s, parent=parent)
 
+    def _prewarm_prefix(self, stream, tokens) -> None:
+        """Second-chance CROSS-REPLICA prefix pre-warm: when this
+        prompt's hash chain has no local cache hit, ask the stream's
+        `prefix_keeper` (injected by the gateway when it runs both a
+        checkpoint keeper and a prefix policy) for a snapshot keyed by
+        the chain head and adopt it into the local cached tier over
+        the transfer plane -- so a follow-up turn landing on a COLD
+        replica still skips the shared-prefix prefill.  Best-effort
+        end to end: any miss/failure just means a normal cold
+        prefill."""
+        engine = self._engine
+        keeper_name = str(self.get_parameter(
+            "prefix_keeper", "", stream) or "")
+        if not keeper_name:
+            return
+        from ..decode.checkpoint import get_keeper
+        from ..decode.prefix import chain_hashes
+        keeper = get_keeper(keeper_name)
+        if keeper is None:
+            return
+        timeout = self.get_parameter("adopt_timeout", None, stream)
+        for row in range(tokens.shape[0]):
+            hashes = chain_hashes(tokens[row],
+                                  engine.blocks.block_size)
+            if not hashes or engine.prefix.lookup(hashes):
+                continue      # local hit (or sub-block prompt)
+            try:
+                record = keeper.restore(("prefix", hashes[0]))
+            except (KeyError, ValueError):
+                continue
+            engine.adopt_prefix(
+                record, timeout=(float(timeout) if timeout else None))
+
+    def _export_prefix(self, entry: dict, row: int) -> None:
+        """Offer a finished request's cached prefix blocks to the
+        stream's prefix keeper (once per chain: skipped when the
+        keeper already holds it).  The keeper ingests asynchronously,
+        so this never blocks the engine pump."""
+        engine = getattr(self, "_engine", None)
+        if engine is None or engine.prefix is None:
+            return
+        prompts = entry.get("prompts")
+        if prompts is None:
+            return
+        from ..decode.checkpoint import get_keeper
+        keeper = get_keeper(entry["prefix_keeper"])
+        if keeper is None:
+            return
+        snapshot = engine.export_prefix_snapshot(prompts[row])
+        if snapshot is None:
+            return
+        if keeper.kept_blocks(tuple(snapshot["request_id"])) \
+                >= snapshot["blocks_total"]:
+            return
+        keeper.store(snapshot)
+
+    def _publish_prefix_heads(self, engine) -> None:
+        """Mirror the cache's resident chain-head digests into the
+        pipeline share (comma-joined, on change only) -- the compact
+        summary gateway prefix-affinity routing scores against."""
+        heads = ",".join(engine.prefix_heads())
+        if heads != getattr(self, "_prefix_heads_shared", ""):
+            self._prefix_heads_shared = heads
+            self.pipeline.set_parameter("prefix_heads", heads)
+
     def _schedule_pump(self):
         """At most ONE pump message in flight: each tick runs one fused
         decode step and re-posts itself while the engine has work, so
@@ -823,6 +910,8 @@ class LMGenerate(ComputeElement):
                 # one cadence tick per engine step; tick() never raises
                 # (a failed snapshot keeps the keeper's previous one)
                 self._checkpointer.tick()
+            if engine.prefix is not None:
+                self._publish_prefix_heads(engine)
         except Exception as error:
             # the mailbox swallows exceptions, so an unguarded failure
             # here (device error, tokenizer crash) would strand every
@@ -891,6 +980,8 @@ class LMGenerate(ComputeElement):
         if entry["stream_tokens"]:
             self._flush_stream_buffer(key, entry, row)
             entry["buffers"].pop(row, None)
+        if entry.get("prefix_keeper"):
+            self._export_prefix(entry, row)
         entry["done"][row] = completion
         if len(entry["done"]) < entry["rows"]:
             return

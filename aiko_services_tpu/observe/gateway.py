@@ -81,6 +81,14 @@ class GatewayTelemetry:
         self.kv_migrations = registry.counter("gateway.kv_migrations")
         self.prefill_fallbacks = registry.counter(
             "gateway.prefill_fallbacks")
+        # prefix-affinity routing (decode/prefix.py): hinted stream
+        # placements that landed on a replica already holding the
+        # stream's prefix chain head vs ones that could not (holder
+        # saturated / draining / not yet warm) -- the A/B evidence the
+        # prefix_cache bench compares across its affinity arms
+        self.affinity_hits = registry.counter("gateway.affinity_hits")
+        self.affinity_misses = registry.counter(
+            "gateway.affinity_misses")
         # warm KV failover (decode/checkpoint.py): migrated streams
         # whose replay was deferred by the recovery_rate pacing window
         self.recovery_paced = registry.counter("gateway.recovery_paced")
@@ -329,6 +337,9 @@ class GatewayTelemetry:
             summary["prefill_fallbacks"] = self.prefill_fallbacks.value
         if self.recovery_paced.value:
             summary["recovery_paced"] = self.recovery_paced.value
+        if self.affinity_hits.value or self.affinity_misses.value:
+            summary["affinity_hits"] = self.affinity_hits.value
+            summary["affinity_misses"] = self.affinity_misses.value
         slo = self.slo_summary()
         if slo:
             # per-priority SLO attainment/burn (the per-tenant

@@ -254,9 +254,17 @@ class PipelineTelemetry:
             trace.events.append(
                 ("X", f"queue:{node}{suffix}", "queue", start, queue,
                  None))
+            # prefix-cache evidence rides the prefill span: the loader
+            # turns prefix_blocks into per-element hit evidence so the
+            # tune model can tell a CACHE-BOUND prefill floor (most of
+            # the prompt skipped) from a compute-bound one
+            prefill_args = None
+            if stats.get("prefix_blocks") is not None:
+                prefill_args = {
+                    "prefix_blocks": stats.get("prefix_blocks")}
             trace.events.append(
                 ("X", f"prefill:{node}{suffix}", "engine", start + queue,
-                 prefill, None))
+                 prefill, prefill_args))
             trace.events.append(
                 ("X", f"decode_steps:{node}{suffix}", "engine",
                  start + queue + prefill,

@@ -136,6 +136,18 @@ class _Replica:
             return int(self.pipeline.load()["inflight"])
         return parse_int(self.cache.get("inflight", 0), 0)
 
+    def prefix_heads(self) -> set:
+        """Chain-head digests this replica's prefix cache holds --
+        live from the pipeline share for local replicas, the EC
+        mirror for discovered ones (elements/ml.py publishes the
+        comma-joined summary on change).  Empty when the replica runs
+        without a prefix cache."""
+        if self.pipeline is not None:
+            raw = self.pipeline.share.get("prefix_heads", "")
+        else:
+            raw = self.cache.get("prefix_heads", "")
+        return {head for head in str(raw or "").split(",") if head}
+
     def reported_queue_depth(self) -> int:
         if self.pipeline is not None:
             return int(self.pipeline.load()["queue_depth"])
@@ -243,7 +255,8 @@ class Gateway(Actor):
                  router_seed: int = 0, faults=None, telemetry: bool = True,
                  metrics_interval: float = 10.0, autoscale=None,
                  replica_factory=None, journal=None, ha=None,
-                 disagg=None, checkpoint=None, federation=None):
+                 disagg=None, checkpoint=None, federation=None,
+                 prefix=None):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
         # construction-time validation through the shared
         # directive-grammar core (analyze/grammar.py): a typo'd policy
@@ -311,6 +324,28 @@ class Gateway(Actor):
                     else "AIKO410")
             raise ValueError(
                 f"{code}: gateway federation policy rejected: "
+                f"{error}") from None
+        # prefix-affinity routing (decode/prefix.py): with a prefix
+        # policy set, a hinted stream's placement biases the
+        # power-of-two-choices sample toward replicas whose mirrored
+        # chain-head summary already holds the stream's prefix
+        # (score - affinity_weight), and -- when a checkpoint keeper
+        # is ALSO configured -- streams carry the keeper name so a
+        # cold replica pre-warms from the cross-replica prefix store.
+        # None (or prefix_cache=off) = pre-prefix routing, bit for bit
+        try:
+            from ..decode.prefix import PrefixPolicy
+            self.prefix = (PrefixPolicy.parse(prefix)
+                           if prefix is not None else None)
+            if self.prefix is not None:
+                self.prefix.validate_gateway()
+                if not self.prefix.enabled:
+                    self.prefix = None
+        except ValueError as error:
+            code = ("AIKO404" if getattr(error, "kind", "") == "unknown"
+                    else "AIKO411")
+            raise ValueError(
+                f"{code}: gateway prefix policy rejected: "
                 f"{error}") from None
         self.federation_group = None
         if self.federation is not None and self.federation.groups:
@@ -1066,20 +1101,52 @@ class Gateway(Actor):
 
     # -- placement ---------------------------------------------------------
 
-    def _place(self, now: float) -> _Replica | None:
+    def _place(self, now: float,
+               prefix_hint: str | None = None) -> _Replica | None:
         """Power-of-two-choices over the placeable DECODE pool: sample
         two, route to the lower load score.  Deterministic under the
         `router_seed` RNG.  Streams only ever pin to decode-role
-        replicas -- a prefill replica holds no slot state to pin to."""
+        replicas -- a prefill replica holds no slot state to pin to.
+
+        With a prefix policy armed and a `prefix_hint` (chain-head
+        digest) on the stream, replicas already holding that head JOIN
+        the sampled pair -- affinity must not depend on the RNG
+        happening to draw the holder -- and the comparison subtracts
+        `affinity_weight` from a holder's load score, so a warm
+        replica wins ties and modest load gaps but a SATURATED holder
+        still loses (placeable() filtered it out entirely, or its raw
+        load dwarfs the discount): affinity degrades to plain
+        balancing, never to a hot spot."""
         candidates = [replica for replica in self.replicas.values()
                       if replica.placeable(now, self.policy)
                       and replica.pool_role() != "prefill"]
         if not candidates:
             return None
+        affinity = self.prefix is not None and bool(prefix_hint)
         if len(candidates) == 1:
-            return candidates[0]
-        first, second = self._rng.sample(candidates, 2)
-        return first if first.score() <= second.score() else second
+            chosen = candidates[0]
+        elif affinity:
+            pool = self._rng.sample(candidates, 2)
+            pool += [replica for replica in candidates
+                     if replica not in pool
+                     and prefix_hint in replica.prefix_heads()]
+            weight = self.prefix.affinity_weight
+
+            def adjusted(replica: _Replica) -> float:
+                discount = (weight if prefix_hint
+                            in replica.prefix_heads() else 0.0)
+                return replica.score() - discount
+
+            chosen = min(pool, key=adjusted)
+        else:
+            first, second = self._rng.sample(candidates, 2)
+            chosen = first if first.score() <= second.score() else second
+        if affinity:
+            if prefix_hint in chosen.prefix_heads():
+                self.telemetry.affinity_hits.inc()
+            else:
+                self.telemetry.affinity_misses.inc()
+        return chosen
 
     def _place_prefill(self, now: float) -> _Replica | None:
         """Least-loaded prefill replica with dispatch capacity, or None
@@ -1163,7 +1230,13 @@ class Gateway(Actor):
                 self._reject_stream(stream_id, "rate_limited",
                                     topic_response, queue_response)
                 return
-        replica = self._place(now)
+        # prefix-affinity: the client's chain-head digest (computed
+        # with decode/prefix.py prefix_head over the shared preamble)
+        # rides the create parameters; replicas mirroring that head
+        # win placement ties (see _place)
+        prefix_hint = (str(parameters.get("prefix_hint") or "")
+                       if self.prefix is not None else "")
+        replica = self._place(now, prefix_hint=prefix_hint or None)
         if replica is None:
             self._reject_stream(stream_id, "no_replica",
                                 topic_response, queue_response)
@@ -1180,6 +1253,14 @@ class Gateway(Actor):
             # frame_deadline): LMGenerate reads it per stream, so one
             # gateway knob governs the whole fleet's adopt fallback
             parameters["adopt_timeout"] = self.disagg.adopt_timeout_s
+        if (self.prefix is not None and self.checkpoint is not None
+                and self.checkpoint.keeper
+                and "prefix_keeper" not in parameters):
+            # prefix + checkpoint together turn the keeper into a
+            # cross-replica prefix store: the replica pre-warms cold
+            # prompts from it and exports finished chains back
+            # (elements/ml.py _prewarm_prefix / _export_prefix)
+            parameters["prefix_keeper"] = self.checkpoint.keeper
         stream = _GatewayStream(
             stream_id, priority, slo_ms, parameters, grace_time, replica,
             queue_response=queue_response, topic_response=topic_response,

@@ -59,6 +59,12 @@ class ElementProfile:
     engine_checkpoint_s: list = field(default_factory=list)
     engine_preemptions: int = 0
     engine_tokens: int = 0
+    # cross-request prefix reuse: per-completion evidence off the
+    # prefill span args (requests with >= 1 borrowed block, and the
+    # total blocks borrowed) -- the cache-bound floor's input
+    engine_prefix_hits: int = 0
+    engine_prefix_requests: int = 0
+    engine_prefix_blocks: int = 0
     # serving-gateway spans (fleet-scope traces): admit-wait (frame
     # submit -> replica dispatch, parked wait included), route
     # decision, failover replay waves, and shed/throttle counts --
@@ -317,6 +323,16 @@ def _ingest_events(loaded: LoadedTrace, events: list,
             span = float(dur) / 1e6
             if name.startswith("prefill:"):
                 profile.engine_prefill_s.append(span)
+                args = event.get("args") or {}
+                shared = args.get("prefix_blocks")
+                if isinstance(shared, (int, float)):
+                    # the span carries prefix_blocks ONLY when the
+                    # replica ran a prefix cache: its presence marks a
+                    # judged request, its value the blocks borrowed
+                    profile.engine_prefix_requests += 1
+                    if int(shared) > 0:
+                        profile.engine_prefix_hits += 1
+                        profile.engine_prefix_blocks += int(shared)
             elif name.startswith("adopt:"):
                 # disaggregated serving: the decode replica's KV
                 # migration (batched transfer-plane fetch + pool

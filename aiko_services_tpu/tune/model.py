@@ -35,6 +35,13 @@
 #   queue-bound     median scheduler wait exceeds median compute: the
 #                   element starves behind coalescing or a saturated
 #                   slot pool, not its own kernel
+#   cache-bound     a prefix-caching decode element serves most
+#                   prefills from shared KV blocks (hit rate past
+#                   CACHE_HIT_RATE_BOUND): the observed prefill span
+#                   is the uncached TAIL, not the full prompt, so the
+#                   prefill floor is set by what the cache misses --
+#                   pin prefix_policy before tuning slots/blocks, and
+#                   read prefill medians as cache-residual time
 #   dispatch-bound  median per-CALL time is at the runtime's dispatch
 #                   floor (and, when FLOP estimates exist, achieved
 #                   utilization is far below peak): the chip is idle
@@ -53,7 +60,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 __all__ = ["ElementCost", "CostModel", "classify_elements",
-           "COMPILE_RATIO_BOUND", "LOW_UTILIZATION_BOUND"]
+           "COMPILE_RATIO_BOUND", "LOW_UTILIZATION_BOUND",
+           "CACHE_HIT_RATE_BOUND"]
 
 # compile events per call past which an element is compile-bound: a
 # healthy steady state compiles each signature once (a handful of
@@ -65,6 +73,11 @@ LOW_UTILIZATION_BOUND = 0.02
 # dispatch-floor multiple up to which low utilization still reads as
 # dispatch-bound (beyond it the kernel is genuinely running long)
 DISPATCH_SPAN_MULTIPLE = 8.0
+# prefix-cache hit rate (requests with >= 1 borrowed block / judged
+# requests) past which an engine element's prefill floor is the cache
+# residual, not the kernel: half the traffic skipping most of its
+# prefill means slot/block knobs no longer describe the workload
+CACHE_HIT_RATE_BOUND = 0.5
 
 
 def _median(values: list) -> float:
@@ -189,6 +202,13 @@ class CostModel:
                     "preemptions": profile.engine_preemptions,
                     "tokens": profile.engine_tokens,
                     "requests": len(profile.engine_decode_s),
+                    "prefix_requests": profile.engine_prefix_requests,
+                    "prefix_hits": profile.engine_prefix_hits,
+                    "prefix_blocks": profile.engine_prefix_blocks,
+                    "prefix_hit_rate": (
+                        profile.engine_prefix_hits
+                        / profile.engine_prefix_requests
+                        if profile.engine_prefix_requests else 0.0),
                 }
             static = static_costs.get(name)
             if static:
@@ -300,6 +320,14 @@ def classify_elements(model: CostModel) -> None:
             cost.floor = "checkpoint-bound"
         elif queue_wait > max(cost.compute_median_s, floor_s):
             cost.floor = "queue-bound"
+        elif ((cost.engine or {}).get("prefix_requests", 0)
+              and (cost.engine or {}).get("prefix_hit_rate", 0.0)
+              >= CACHE_HIT_RATE_BOUND):
+            # most prefills borrowed their prompt's leading KV from
+            # the prefix cache: the measured prefill span is the
+            # uncached tail, so the floor is cache residency (what the
+            # cache misses), not the prefill kernel's speed
+            cost.floor = "cache-bound"
         elif cost.per_call_median_s <= floor_s or (
                 cost.achieved_utilization is not None
                 and cost.achieved_utilization < LOW_UTILIZATION_BOUND

@@ -324,6 +324,28 @@ def _engine_recommendations(name, cost, parameters, slo) -> list:
             "max_checkpoint_lag, hot-loop headroom returns)",
             floor=cost.floor, evidence=cost.evidence))
         return recommendations
+    if cost.floor == "cache-bound":
+        # most prefills borrowed their prompt's leading KV from the
+        # prefix cache, so the measured prefill median is the uncached
+        # TAIL: the slot/block heuristics below would size the pool
+        # for work the cache already absorbed.  The knob that matters
+        # is keeping the cache armed across redeploys -- pin
+        # prefix_policy when the definition leaves it implicit (and
+        # only then: a pin of an already-pinned policy would be a
+        # proposed==current no-op)
+        if not parameters.get("prefix_policy"):
+            recommendations.append(Recommendation(
+                f"element:{name}", "prefix_policy", None,
+                "prefix_cache=on",
+                f"cache-bound at {name}: "
+                f"{engine.get('prefix_hit_rate', 0.0):.0%} of judged "
+                "prefills borrowed cached prefix KV "
+                f"({engine.get('prefix_blocks', 0)} blocks total) -- "
+                "pin the policy so redeploys keep the cache, and read "
+                "prefill medians as cache-residual tail time, not "
+                "kernel time",
+                floor=cost.floor, evidence=cost.evidence))
+        return recommendations
     if engine.get("queue_median_s", 0.0) > max(compute, 1e-9):
         proposed = min(slots * 2, 64)
         if proposed > slots:

@@ -2771,7 +2771,224 @@ def bench_spec_decode(peak):
     }
 
 
-# -- config 6e: prefill/decode disaggregation --------------------------------
+# -- config 6e: cross-request prefix KV reuse --------------------------------
+
+def _prefix_cache_definition(name, max_new=16, slots=4):
+    """One prefix-caching continuous decode replica: the definition the
+    `prefix_cache` config exercises, also collected into the `aiko lint
+    --bench` surface so its AIKO405/411 parameter set stays strict-mode
+    clean."""
+    return {
+        "name": name,
+        "parameters": {"telemetry": TELEMETRY,
+                       "metrics_interval": 60.0},
+        "graph": ["(lm)"],
+        "elements": [
+            {"name": "lm",
+             "input": [{"name": "tokens", "type": "any"}],
+             "output": [{"name": "generated", "type": "any"}],
+             "parameters": {
+                 "vocab_size": 300, "d_model": 32, "n_layers": 1,
+                 "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+                 "max_seq_len": 128, "dtype": "float32",
+                 "max_new_tokens": max_new, "continuous": True,
+                 "decode_slots": slots, "kv_block_size": 8,
+                 "stream_tokens": True, "stream_chunk": 1,
+                 "prefix_policy": ("prefix_cache=on;"
+                                   "min_prefix_blocks=1;"
+                                   "cache_blocks=32")},
+             "deploy": {"local": {"module": ELEMENTS,
+                                  "class_name": "LMGenerate"}}},
+        ],
+    }
+
+
+def bench_prefix_cache(peak):
+    """`prefix_cache` config: cross-request prefix KV reuse
+    (decode/prefix.py).  A shared-system-prompt storm -- every request
+    is the same long prefix plus a unique fixed-length tail -- runs
+    twice over the SAME seeded workload: cold (no prefix policy, every
+    prompt pays the full quadratic prefill) vs warm (prefix_cache=on,
+    repeat prompts borrow the cached prompt blocks and prefill only
+    the tail).  Requests are submitted sequentially, so per-request
+    TTFT is the prefill cost itself; the arms must be BIT-IDENTICAL
+    (f32 AND int8 KV) with zero warm-arm recompiles in the measured
+    window.  A third stage A/Bs the gateway's prefix-affinity routing
+    (serve/gateway.py _place) over two replica caches: the on arm must
+    beat hint-blind power-of-two routing on aggregate hit rate."""
+    import jax
+    import numpy as np
+
+    from dataclasses import replace
+
+    from aiko_services_tpu.decode import DecodeEngine, prefix_head
+    from aiko_services_tpu.models import count_params, init_params
+    from aiko_services_tpu.models.configs import LLAMA32_1B, LM_TOY
+    from aiko_services_tpu.runtime import Process
+    from aiko_services_tpu.serve import Gateway
+    from aiko_services_tpu.serve.gateway import _Replica
+    from aiko_services_tpu.transport import reset_brokers
+
+    config = LM_TOY if SMOKE else LLAMA32_1B
+    name = "lm_toy" if SMOKE else "llama32_1b"
+    slots = 2 if SMOKE else 4
+    block = 8 if SMOKE else 32
+    prefix_len = 32 if SMOKE else 1024   # the shared system prompt
+    tail_len = 8 if SMOKE else 64        # fixed: one tail chunk bucket
+    requests_n = 6 if SMOKE else 16
+    max_new = 8 if SMOKE else 32
+    armed = "prefix_cache=on"
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(29)
+    system = rng.integers(1, config.vocab_size,
+                          size=prefix_len).astype(np.int32)
+    workload = [
+        np.concatenate([system,
+                        rng.integers(1, config.vocab_size,
+                                     size=tail_len).astype(np.int32)])
+        for _ in range(requests_n)]
+    total_len = prefix_len + tail_len
+    max_context = (-(-(total_len + max_new) // block)) * block
+
+    def run_arm(arm_config, arm_params, prefix_policy):
+        engine = DecodeEngine(
+            arm_params, arm_config, decode_slots=slots,
+            kv_block_size=block, max_context=max_context,
+            prefix_policy=prefix_policy)
+        # warmup compiles BOTH prefill shapes the window touches: the
+        # cold monolithic bucket and (when armed) the warm tail chunk
+        # -- the probe prompt repeats so the second run takes the
+        # cache-hit path, then the cache is dropped so the measured
+        # window starts cold
+        probe = np.ones((total_len,), np.int32)
+        _engine_warmup(engine, [total_len])
+        engine.submit(("warm", 1), probe, 2)
+        while engine.has_work():
+            engine.step()
+        if engine.prefix is not None:
+            engine.prefix.drop()
+        compiles_before = engine.compile_count
+        hits_before = engine.counters["prefix_hits"]
+        shared_before = engine.counters["prefix_blocks_shared"]
+        outputs, ttfts = {}, []
+        for index, prompt in enumerate(workload):
+            engine.submit(index, prompt, max_new)
+            while engine.has_work():
+                for completion in engine.step().completions:
+                    outputs[completion.request_id] = completion.tokens
+                    ttfts.append(completion.stats["ttft_s"] * 1000)
+        return {
+            "ttft_p50_ms": round(float(np.median(ttfts)), 2),
+            "ttft_p99_ms": round(float(np.quantile(ttfts, 0.99)), 2),
+            "compiles_in_window":
+                engine.compile_count - compiles_before,
+            "prefix_hits": engine.counters["prefix_hits"] - hits_before,
+            "blocks_shared": (engine.counters["prefix_blocks_shared"]
+                              - shared_before),
+            "evictions": (engine.prefix.evictions
+                          if engine.prefix is not None else 0),
+        }, outputs
+
+    cold, cold_outputs = run_arm(config, params, None)
+    warm, warm_outputs = run_arm(config, params, armed)
+    warm["hit_rate"] = round(warm["prefix_hits"] / requests_n, 3)
+    bit_identical_f32 = all(
+        np.array_equal(cold_outputs[index], warm_outputs[index])
+        for index in cold_outputs)
+
+    # int8 KV: the shared blocks carry their per-block scales, so the
+    # warm path must round-trip the quantized cache bit-exactly too
+    int8_config = replace(config, kv_dtype="int8")
+    int8_params = init_params(int8_config, jax.random.PRNGKey(0))
+    int8_cold, int8_cold_outputs = run_arm(int8_config, int8_params,
+                                           None)
+    int8_warm, int8_warm_outputs = run_arm(int8_config, int8_params,
+                                           armed)
+    bit_identical_int8 = all(
+        np.array_equal(int8_cold_outputs[index],
+                       int8_warm_outputs[index])
+        for index in int8_cold_outputs)
+
+    def affinity_arm(use_affinity):
+        """Two replica caches behind the REAL _place scoring: seeded
+        per-group prompts, sequential streams, each replica mirroring
+        its chain heads the way elements/ml.py publishes them."""
+        reset_brokers()
+        groups = 3 if SMOKE else 4
+        per_group = 4 if SMOKE else 8
+        arm_rng = np.random.default_rng(31)
+        prefixes = [arm_rng.integers(1, 300, size=16).astype(np.int32)
+                    for _ in range(groups)]
+        toy = replace(LM_TOY, vocab_size=300)
+        toy_params = init_params(toy, jax.random.PRNGKey(2))
+        gateway = Gateway(
+            Process(transport_kind="loopback"),
+            policy="max_inflight=8;queue=32", router_seed=23,
+            prefix=("prefix_cache=on;affinity_weight=2"
+                    if use_affinity else None))
+        engines, mirrors = {}, {}
+        for replica_name in ("r0", "r1"):
+            engines[replica_name] = DecodeEngine(
+                toy_params, toy, decode_slots=2, kv_block_size=8,
+                prefix_policy=armed)
+            mirror = _Replica(f"bench/{replica_name}", replica_name,
+                              cache={"inflight": 0, "prefix_heads": ""})
+            mirrors[replica_name] = mirror
+            gateway.replicas[mirror.topic_path] = mirror
+        placed, hits = 0, 0
+        for round_index in range(per_group):
+            for group, prefix in enumerate(prefixes):
+                prompt = np.concatenate([
+                    prefix, arm_rng.integers(1, 300, size=8)
+                    .astype(np.int32)])
+                hint = prefix_head(prompt, 8)
+                chosen = gateway._place(
+                    0.0, prefix_hint=hint if use_affinity else None)
+                engine = engines[chosen.name]
+                before = engine.counters["prefix_hits"]
+                engine.submit((group, round_index), prompt, 2)
+                while engine.has_work():
+                    engine.step()
+                hits += engine.counters["prefix_hits"] - before
+                placed += 1
+                mirrors[chosen.name].cache["prefix_heads"] = ",".join(
+                    engine.prefix_heads())
+        return round(hits / placed, 3)
+
+    affinity_on = affinity_arm(True)
+    affinity_off = affinity_arm(False)
+
+    return {
+        "model": f"{name} ({count_params(params) / 1e6:.0f}M params)",
+        "decode_slots": slots,
+        "kv_block_size": block,
+        "shared_prefix_len": prefix_len,
+        "tail_len": tail_len,
+        "requests": requests_n,
+        "max_new": max_new,
+        "cold": cold,
+        "warm": warm,
+        "int8": {"cold_ttft_p50_ms": int8_cold["ttft_p50_ms"],
+                 "warm_ttft_p50_ms": int8_warm["ttft_p50_ms"],
+                 "prefix_hits": int8_warm["prefix_hits"]},
+        "prefix_hits": warm["prefix_hits"],
+        "hit_rate": warm["hit_rate"],
+        "blocks_shared": warm["blocks_shared"],
+        "ttft_collapse": round(
+            cold["ttft_p50_ms"] / max(warm["ttft_p50_ms"], 1e-9), 2),
+        "compiles_in_window": warm["compiles_in_window"],
+        "bit_identical": bit_identical_f32 and bit_identical_int8,
+        "bit_identical_f32": bit_identical_f32,
+        "bit_identical_int8": bit_identical_int8,
+        "affinity": {
+            "on_hit_rate": affinity_on,
+            "off_hit_rate": affinity_off,
+            "advantage": round(affinity_on - affinity_off, 3),
+        },
+    }
+
+
+# -- config 6f: prefill/decode disaggregation --------------------------------
 
 def bench_disagg(peak):
     """`disagg` config: prefill/decode disaggregation (ROADMAP #2,
@@ -3492,6 +3709,7 @@ def collect_definitions() -> dict:
              "dtype": "float32" if SMOKE else "bfloat16"}),
         "chaos": _chaos_definition("bench_chaos"),
         "chaos_decode": _chaos_decode_definition("bench_chaos_decode"),
+        "prefix_cache": _prefix_cache_definition("bench_prefix_cache"),
         "scale": _scale_definition("bench_scale"),
         "tts": _tts_definition(
             "hello" if SMOKE else
@@ -3519,6 +3737,9 @@ _SUMMARY_FIELDS = (
     ("chunked_prefill", "stall_speedup", "chunk_stall_speedup"),
     ("spec_decode", "accepted_len_mean", "spec_accept_mean"),
     ("spec_decode", "ceiling_speedup", "spec_ceiling_speedup"),
+    ("prefix_cache", "hit_rate", "prefix_hit_rate"),
+    ("prefix_cache", "ttft_collapse", "prefix_ttft_collapse"),
+    ("prefix_cache", "bit_identical", "prefix_bit_identical"),
     ("latency", "p50_ms", "latency_p50_ms"),
     ("autoscale", "time_to_healthy_warm_ms", "tth_warm_ms"),
     ("autoscale", "warm_vs_cold_speedup", "warm_speedup"),
@@ -3629,8 +3850,8 @@ def main() -> None:
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
                        "longcontext,serving,continuous,chunked_prefill,"
-                       "spec_decode,disagg,autoscale,chaos,latency,scale,"
-                       "tts,pipeline")
+                       "spec_decode,prefix_cache,disagg,autoscale,chaos,"
+                       "latency,scale,tts,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -3656,6 +3877,8 @@ def main() -> None:
         configs["chunked_prefill"] = bench_chunked_prefill(peak)
     if "spec_decode" in wanted:
         configs["spec_decode"] = bench_spec_decode(peak)
+    if "prefix_cache" in wanted:
+        configs["prefix_cache"] = bench_prefix_cache(peak)
     if "disagg" in wanted:
         configs["disagg"] = _with_control_plane(bench_disagg, peak)
     if router_replicas is not None or "router" in wanted:

@@ -1,0 +1,476 @@
+# Cross-request prefix KV reuse (ISSUE 16): the hash-chain identity,
+# refcounted COW sharing on the paged pool (warm admissions borrow
+# cached prompt blocks and prefill only the tail), eviction/accounting
+# reconciliation under storms and preemption, bit-identity with cold
+# prefill for f32 AND int8 KV, and the gateway's prefix-affinity
+# power-of-two routing (warm replica wins, saturated holder loses).
+
+import numpy as np
+import pytest
+
+import jax
+
+from aiko_services_tpu.decode import (
+    BlockManager, DecodeEngine, PrefixCache, PrefixPolicy, chain_hashes,
+    prefix_head)
+from aiko_services_tpu.models import TransformerConfig, generate, init_params
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.serve import Gateway
+from aiko_services_tpu.serve.gateway import _Replica
+from aiko_services_tpu.transport import reset_brokers
+
+TINY = dict(vocab_size=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_model=32, d_ff=64, max_seq_len=64, dtype="float32")
+
+ARMED = "prefix_cache=on"
+
+
+@pytest.fixture(autouse=True)
+def clean_brokers():
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    config = TransformerConfig(**TINY)
+    return init_params(config, jax.random.PRNGKey(0)), config
+
+
+def reference(params, config, prompt, max_new):
+    """Closed-batch greedy completion -- the bit-identity oracle."""
+    out, _ = generate(params, config, np.asarray(prompt)[None],
+                      max_new_tokens=max_new)
+    return np.asarray(out)[0]
+
+
+def drain(engine, limit=2000):
+    done = {}
+    steps = 0
+    while engine.has_work():
+        report = engine.step()
+        for completion in report.completions:
+            done[completion.request_id] = completion
+        steps += 1
+        assert steps < limit, "engine failed to drain (deadlock?)"
+    return done
+
+
+# -- hash chain --------------------------------------------------------------
+
+class TestChainHashes:
+    def test_deterministic_and_prefix_stable(self):
+        tokens = np.arange(1, 25, dtype=np.int32)
+        first = chain_hashes(tokens, 8)
+        assert first == chain_hashes(tokens, 8)
+        assert len(first) == 3                    # full blocks only
+        assert len(chain_hashes(tokens[:23], 8)) == 2
+        # a chain digest commits to the WHOLE prefix, so a chain over a
+        # token prefix is a list prefix of the full chain
+        assert chain_hashes(tokens[:16], 8) == first[:2]
+        assert prefix_head(tokens, 8) == first[0]
+        assert prefix_head(tokens[:7], 8) is None
+
+    def test_block_size_seeds_distinct_namespaces(self):
+        tokens = np.arange(1, 9, dtype=np.int32)
+        assert chain_hashes(tokens, 8)[0] != chain_hashes(tokens, 4)[0]
+        assert len(set(chain_hashes(tokens, 4))) == 2
+
+    def test_divergence_changes_suffix_digests(self):
+        base = np.arange(1, 25, dtype=np.int32)
+        fork = base.copy()
+        fork[8] += 1                              # mutate block 1
+        left, right = chain_hashes(base, 8), chain_hashes(fork, 8)
+        assert left[0] == right[0]
+        assert left[1] != right[1]
+        assert left[2] != right[2]                # chained: all later differ
+
+
+# -- policy grammar ----------------------------------------------------------
+
+class TestPrefixPolicy:
+    def test_parse_defaults_and_off(self):
+        policy = PrefixPolicy.parse(ARMED)
+        assert policy.enabled and policy.min_prefix_blocks == 1
+        assert not PrefixPolicy.parse("prefix_cache=off").enabled
+
+    def test_scope_validation(self):
+        gateway_only = PrefixPolicy.parse(
+            "prefix_cache=on;affinity_weight=2")
+        gateway_only.validate_gateway()
+        with pytest.raises(ValueError, match="affinity_weight"):
+            gateway_only.validate_engine()
+        engine_only = PrefixPolicy.parse(
+            "prefix_cache=on;min_prefix_blocks=2;cache_blocks=8")
+        engine_only.validate_engine()
+        with pytest.raises(ValueError, match="min_prefix_blocks"):
+            engine_only.validate_gateway()
+
+    def test_bad_values_rejected(self):
+        with pytest.raises(ValueError):
+            PrefixPolicy.parse("prefix_cache=maybe")
+        with pytest.raises(ValueError):
+            PrefixPolicy.parse("prefix_cache=on;min_prefix_blocks=0")
+        with pytest.raises(ValueError):
+            PrefixPolicy.parse("prefix_cache=on;warmth=high")
+
+
+# -- BlockManager free-set (O(1) double-free guard) --------------------------
+
+class TestBlockManagerFreeSet:
+    def test_free_set_mirrors_list_through_storm(self):
+        """The membership set behind free() stays exactly in sync with
+        the LIFO list through an interleaved allocate/free storm -- the
+        O(n) scan this replaced would have made release waves O(n^2)."""
+        manager = BlockManager(64, 4)
+        rng = np.random.default_rng(11)
+        held = []
+        for _ in range(200):
+            if held and rng.integers(0, 2):
+                batch = held.pop()
+                manager.free(batch)
+            else:
+                granted = manager.allocate(int(rng.integers(1, 6)))
+                if granted is not None:
+                    held.append(granted)
+            assert manager._free_set == set(manager._free)
+            assert manager.free_count == len(manager._free)
+        for batch in held:
+            manager.free(batch)
+        assert manager.free_count == manager.capacity
+
+    def test_double_free_still_rejected(self):
+        manager = BlockManager(8, 4)
+        granted = manager.allocate(2)
+        manager.free(granted)
+        with pytest.raises(ValueError, match="double free"):
+            manager.free([granted[0]])
+
+
+# -- PrefixCache unit --------------------------------------------------------
+
+class TestPrefixCacheUnit:
+    def test_register_acquire_release_refcounts(self):
+        manager = BlockManager(10, 4)
+        cache = PrefixCache(manager)
+        tokens = np.arange(1, 13, dtype=np.int32)
+        hashes = chain_hashes(tokens, 4)
+        blocks = manager.allocate(3)
+        assert len(cache.register(hashes, blocks)) == 3
+        assert cache.shared_count == 3 and cache.cached_count == 0
+        cache.release(blocks)
+        assert cache.shared_count == 0 and cache.cached_count == 3
+        matched = cache.acquire(hashes[:2])
+        assert matched == blocks[:2]              # chain order
+        assert cache.shared_count == 2 and cache.cached_count == 1
+        assert cache.hits == 1
+        cache.release(matched)
+        with pytest.raises(ValueError, match="released more times"):
+            cache.release([blocks[0]])            # below zero
+
+    def test_resident_blocks_peeks_without_acquiring(self):
+        manager = BlockManager(10, 4)
+        cache = PrefixCache(manager)
+        hashes = chain_hashes(np.arange(1, 9, dtype=np.int32), 4)
+        blocks = manager.allocate(2)
+        cache.register(hashes, blocks)
+        assert cache.resident_blocks(hashes) == blocks
+        assert cache.resident_blocks(hashes + ["missing"]) == blocks
+        assert cache.shared_count == 2            # unchanged: no acquire
+        assert cache.lookup(hashes) == 2
+
+    def test_allocate_evicts_lru_before_failing(self):
+        manager = BlockManager(8, 4)              # capacity 7
+        cache = PrefixCache(manager)
+        hashes = chain_hashes(np.arange(1, 13, dtype=np.int32), 4)
+        blocks = manager.allocate(3)
+        cache.register(hashes, blocks)
+        cache.release(blocks)                     # all 3 now rc0/LRU
+        private = cache.allocate(4)               # uses the plain free 4
+        assert len(private) == 4
+        assert manager.free_count == 0
+        granted = cache.allocate(2)               # must reclaim cached
+        assert len(granted) == 2
+        assert cache.evictions == 2
+        assert cache.cached_count == 1
+        # LRU order: the chain HEAD was evicted first, so the longest
+        # resident prefix is now empty (the chain broke at its root)
+        assert cache.lookup(hashes) == 0
+        cache.allocate(2)                         # cannot be satisfied
+        assert cache.evictions == 3 and cache.cached_count == 0
+        manager.free(private + granted)
+
+    def test_cache_blocks_cap_trims_idle_tier(self):
+        manager = BlockManager(10, 4)
+        cache = PrefixCache(manager, cache_blocks=2)
+        hashes = chain_hashes(np.arange(1, 17, dtype=np.int32), 4)
+        blocks = manager.allocate(4)
+        cache.register(hashes, blocks)
+        assert cache.shared_count == 4            # referenced: cap ignores
+        cache.release(blocks)
+        assert cache.cached_count == 2            # trimmed to the cap
+        assert cache.evictions == 2
+        assert manager.free_count == manager.capacity - 2
+
+    def test_unregistered_release_goes_back_to_manager(self):
+        manager = BlockManager(8, 4)
+        cache = PrefixCache(manager)
+        blocks = cache.allocate(3)
+        cache.release(blocks)                     # never registered
+        assert manager.free_count == manager.capacity
+        assert cache.cached_count == 0
+
+
+# -- engine: warm bit-identity ----------------------------------------------
+
+@pytest.mark.parametrize("chunk", [None, 8],
+                         ids=["monolithic", "chunked"])
+def test_warm_prefill_bit_identical_f32(tiny_model, chunk):
+    """A repeat prompt borrows its cached prompt blocks and prefills
+    only the tail; the completion is bit-identical to the cold run."""
+    params, config = tiny_model
+    prompt = np.arange(1, 21, dtype=np.int32)     # 2 full blocks of 8
+    expected = reference(params, config, prompt, 6)
+    engine = DecodeEngine(params, config, decode_slots=2, kv_block_size=8,
+                          prefill_chunk_size=chunk, prefix_policy=ARMED)
+    engine.submit(0, prompt, 6)
+    done = drain(engine)
+    np.testing.assert_array_equal(done[0].tokens, expected)
+    assert engine.counters["prefix_hits"] == 0    # cold: nothing cached
+    engine.submit(1, prompt, 6)
+    done = drain(engine)
+    np.testing.assert_array_equal(done[1].tokens, expected)
+    assert engine.counters["prefix_hits"] == 1
+    assert engine.counters["prefix_blocks_shared"] == 2
+    assert done[1].stats["prefix_blocks"] == 2
+    assert engine.prefix.shared_count == 0        # all refs released
+    assert (engine.blocks.free_count + engine.prefix.cached_count
+            == engine.blocks.capacity)
+
+
+def test_warm_prefill_bit_identical_int8():
+    """Shared int8 KV blocks carry their per-block scales: a warm
+    admission is bit-identical to the cold int8 path too."""
+    config = TransformerConfig(**{**TINY, "kv_dtype": "int8"})
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = np.arange(1, 21, dtype=np.int32)
+    cold = DecodeEngine(params, config, decode_slots=1, kv_block_size=8)
+    cold.submit(0, prompt, 6)
+    expected = drain(cold)[0].tokens
+    engine = DecodeEngine(params, config, decode_slots=1, kv_block_size=8,
+                          prefix_policy=ARMED)
+    engine.submit(0, prompt, 6)
+    np.testing.assert_array_equal(drain(engine)[0].tokens, expected)
+    engine.submit(1, prompt, 6)
+    done = drain(engine)
+    np.testing.assert_array_equal(done[1].tokens, expected)
+    assert engine.counters["prefix_hits"] == 1
+    assert done[1].stats["prefix_blocks"] == 2
+
+
+def test_partial_hit_prefills_only_the_uncached_tail(tiny_model):
+    """A prompt sharing one leading block with the cache gets that
+    block for free, counts a partial hit, and computes the rest."""
+    params, config = tiny_model
+    base = np.arange(1, 25, dtype=np.int32)       # 3 full blocks of 8
+    engine = DecodeEngine(params, config, decode_slots=2, kv_block_size=8,
+                          prefix_policy=ARMED)
+    engine.submit(0, base, 4)
+    drain(engine)
+    fork = base.copy()
+    fork[8:] = (fork[8:] + 7) % 63 + 1            # diverge from block 1 on
+    engine.submit(1, fork, 4)
+    done = drain(engine)
+    np.testing.assert_array_equal(
+        done[1].tokens, reference(params, config, fork, 4))
+    assert engine.counters["prefix_hits"] == 1
+    assert engine.counters["prefix_partial_hits"] == 1
+    assert done[1].stats["prefix_blocks"] == 1    # only the common head
+
+
+def test_cow_fork_on_divergence_decodes_concurrently(tiny_model):
+    """Two live requests share the same cached prefix blocks and fork
+    into private tails: neither corrupts the other (COW by block-table
+    indirection -- decode writes always land in slot-owned blocks)."""
+    params, config = tiny_model
+    base = np.arange(1, 17, dtype=np.int32)       # the shared 2 blocks
+    left = np.concatenate([base, np.arange(20, 26, dtype=np.int32)])
+    right = np.concatenate([base, np.arange(40, 48, dtype=np.int32)])
+    engine = DecodeEngine(params, config, decode_slots=2, kv_block_size=8,
+                          prefix_policy=ARMED)
+    engine.submit(0, base, 2)                     # seed the cache
+    drain(engine)
+    engine.submit(1, left, 6)
+    engine.submit(2, right, 6)
+    done = drain(engine)
+    np.testing.assert_array_equal(
+        done[1].tokens, reference(params, config, left, 6))
+    np.testing.assert_array_equal(
+        done[2].tokens, reference(params, config, right, 6))
+    assert engine.counters["prefix_hits"] >= 2
+    assert engine.prefix.shared_count == 0
+
+
+def test_min_prefix_blocks_skips_tiny_matches(tiny_model):
+    params, config = tiny_model
+    prompt = np.arange(1, 13, dtype=np.int32)     # 1 usable block only
+    engine = DecodeEngine(
+        params, config, decode_slots=1, kv_block_size=8,
+        prefix_policy="prefix_cache=on;min_prefix_blocks=2")
+    engine.submit(0, prompt, 4)
+    drain(engine)
+    engine.submit(1, prompt, 4)
+    done = drain(engine)
+    np.testing.assert_array_equal(
+        done[1].tokens, reference(params, config, prompt, 4))
+    assert engine.counters["prefix_hits"] == 0    # below the floor
+    assert engine.prefix.shared_count == 0
+
+
+# -- eviction / accounting under pressure ------------------------------------
+
+def test_accounting_reconciles_through_storm(tiny_model):
+    """Seeded admission waves over shared prefixes with an
+    oversubscribed pool: after every wave the pool partitions exactly
+    into free + cached (no leak, no double count), and dropping the
+    idle tier returns the pool to its cold state."""
+    params, config = tiny_model
+    rng = np.random.default_rng(3)
+    bases = [rng.integers(1, 64, size=16).astype(np.int32)
+             for _ in range(3)]
+    engine = DecodeEngine(params, config, decode_slots=3, kv_block_size=8,
+                          kv_blocks=12, prefix_policy=ARMED)
+    capacity = engine.blocks.capacity
+    request = 0
+    for _ in range(4):
+        for base in bases:
+            tail = rng.integers(
+                1, 64, size=int(rng.integers(0, 9))).astype(np.int32)
+            engine.submit(request, np.concatenate([base, tail]), 4)
+            request += 1
+        done = drain(engine)
+        assert len(done) == 3
+        assert engine.prefix.shared_count == 0
+        assert (engine.blocks.free_count + engine.prefix.cached_count
+                == capacity)
+        done.clear()
+    assert engine.counters["prefix_hits"] > 0
+    assert engine.counters["prefix_evictions"] == engine.prefix.evictions
+    engine.prefix.drop()
+    assert engine.prefix.cached_count == 0
+    assert engine.blocks.free_count == capacity
+
+
+def test_preempting_shared_holder_never_frees_siblings_blocks(tiny_model):
+    """Pool exhaustion preempts the youngest slot while it BORROWS a
+    cached block another slot also references: the release only
+    decrefs -- the survivor keeps decoding over intact KV and both
+    complete bit-identical."""
+    params, config = tiny_model
+    engine = DecodeEngine(params, config, decode_slots=2, kv_block_size=4,
+                          kv_blocks=8, prefix_policy=ARMED)
+    prompt = np.arange(1, 9, dtype=np.int32)      # 2 full blocks of 4
+    expected = reference(params, config, prompt, 12)
+    engine.submit(0, prompt, 12)
+    engine.step()                                 # prefill registers blocks
+    assert engine.prefix.shared_count == 2
+    engine.submit(1, prompt, 12)
+    done = drain(engine)
+    assert engine.counters["preempted"] >= 1
+    assert engine.counters["prefix_hits"] >= 1
+    np.testing.assert_array_equal(done[0].tokens, expected)
+    np.testing.assert_array_equal(done[1].tokens, expected)
+    assert engine.prefix.shared_count == 0
+    assert (engine.blocks.free_count + engine.prefix.cached_count
+            == engine.blocks.capacity)
+
+
+def test_feature_off_is_the_cold_path(tiny_model):
+    """No policy (or prefix_cache=off) means no cache object, no new
+    counters moving, and byte-for-byte the pre-prefix release path."""
+    params, config = tiny_model
+    for spec in (None, "prefix_cache=off"):
+        engine = DecodeEngine(params, config, decode_slots=2,
+                              kv_block_size=8, prefix_policy=spec)
+        assert engine.prefix is None
+        prompt = np.arange(1, 21, dtype=np.int32)
+        engine.submit(0, prompt, 4)
+        engine.submit(1, prompt, 4)
+        done = drain(engine)
+        assert engine.counters["prefix_hits"] == 0
+        assert "prefix_blocks" not in done[1].stats
+        assert engine.blocks.free_count == engine.blocks.capacity
+
+
+# -- gateway affinity routing ------------------------------------------------
+
+HEAD = "a" * 32
+
+
+def _affinity_gateway(weight=2.0, seed=0, prefix=True):
+    process = Process(transport_kind="loopback")
+    spec = (f"prefix_cache=on;affinity_weight={weight}"
+            if prefix else None)
+    return Gateway(process, policy="max_inflight=8;queue=32",
+                   router_seed=seed, prefix=spec)
+
+
+def _fake_replica(name, inflight=0, heads=""):
+    return _Replica(f"pool/{name}", name,
+                    cache={"inflight": inflight, "prefix_heads": heads})
+
+
+class TestAffinityRouting:
+    def test_warm_replica_wins_modest_load_gap(self):
+        gateway = _affinity_gateway(weight=2.0)
+        warm = _fake_replica("warm", inflight=1, heads=HEAD)
+        for replica in (warm, _fake_replica("cold0"),
+                        _fake_replica("cold1")):
+            gateway.replicas[replica.topic_path] = replica
+        for _ in range(4):                        # every draw, not one lucky
+            assert gateway._place(0.0, prefix_hint=HEAD) is warm
+        assert gateway.telemetry.affinity_hits.value == 4
+        assert gateway.telemetry.affinity_misses.value == 0
+
+    def test_overloaded_holder_loses_to_balance(self):
+        gateway = _affinity_gateway(weight=2.0)
+        hot = _fake_replica("hot", inflight=6, heads=HEAD)
+        for replica in (hot, _fake_replica("cold0"),
+                        _fake_replica("cold1")):
+            gateway.replicas[replica.topic_path] = replica
+        chosen = gateway._place(0.0, prefix_hint=HEAD)
+        assert chosen is not hot                  # discount < load gap
+        assert gateway.telemetry.affinity_misses.value == 1
+
+    def test_saturated_holder_falls_back_cleanly(self):
+        gateway = _affinity_gateway(weight=10.0)
+        full = _fake_replica("full", heads=HEAD)
+        full.outstanding = gateway.policy.max_inflight   # latches saturated
+        cold = _fake_replica("cold0")
+        for replica in (full, cold, _fake_replica("cold1")):
+            gateway.replicas[replica.topic_path] = replica
+        chosen = gateway._place(0.0, prefix_hint=HEAD)
+        assert chosen is not full                 # filtered before scoring
+        assert gateway.telemetry.affinity_misses.value == 1
+
+    def test_no_hint_or_no_policy_keeps_counters_still(self):
+        for prefix in (True, False):
+            gateway = _affinity_gateway(prefix=prefix)
+            for index in range(3):
+                replica = _fake_replica(f"r{index}")
+                gateway.replicas[replica.topic_path] = replica
+            assert gateway._place(0.0) is not None
+            assert gateway._place(0.0, prefix_hint=HEAD if not prefix
+                                  else None) is not None
+            assert gateway.telemetry.affinity_hits.value == 0
+            assert gateway.telemetry.affinity_misses.value == 0
+
+    def test_gateway_scope_grammar_rejected_at_construction(self):
+        process = Process(transport_kind="loopback")
+        with pytest.raises(ValueError, match="AIKO411"):
+            Gateway(process, policy="max_inflight=8;queue=32",
+                    prefix="prefix_cache=on;min_prefix_blocks=2")
+        with pytest.raises(ValueError, match="AIKO404"):
+            Gateway(process, policy="max_inflight=8;queue=32",
+                    prefix="prefix_cache=on;warmth=high")
