@@ -72,6 +72,7 @@ RULES = {
                          "spec"),
     "AIKO410": ("error", "invalid gateway federation spec"),
     "AIKO411": ("error", "invalid prefix-cache policy spec"),
+    "AIKO412": ("error", "invalid autopilot policy spec"),
     # -- AIKO5xx: profile-guided tuning (tune/) --------------------------
     "AIKO501": ("error", "invalid tune SLO/directive spec"),
     "AIKO502": ("warning", "tune recommendation not applicable to the "

@@ -14,13 +14,13 @@ from .diagnostics import AnalysisReport, Diagnostic
 from .grammar import Field, GrammarError, split_directives
 
 __all__ = ["run_policy_pass", "check_gateway_policy",
-           "check_autoscale_policy", "check_checkpoint_policy",
-           "check_disagg_policy", "check_faults_spec",
-           "check_federation_policy", "check_journal_policy",
-           "check_decode_parameters", "check_prefix_policy",
-           "check_tune_spec", "parse_speculative_spec",
-           "FAULT_TOLERANCE_FIELDS", "DECODE_FIELDS", "DISAGG_FIELDS",
-           "SPECULATIVE_FIELDS"]
+           "check_autopilot_policy", "check_autoscale_policy",
+           "check_checkpoint_policy", "check_disagg_policy",
+           "check_faults_spec", "check_federation_policy",
+           "check_journal_policy", "check_decode_parameters",
+           "check_prefix_policy", "check_tune_spec",
+           "parse_speculative_spec", "FAULT_TOLERANCE_FIELDS",
+           "DECODE_FIELDS", "DISAGG_FIELDS", "SPECULATIVE_FIELDS"]
 
 # The PR-3 fault-tolerance parameter vocabulary (pipeline / element /
 # stream scoped).  `on_error` choices are filled in lazily from the
@@ -379,6 +379,22 @@ def check_prefix_policy(spec, element: bool = False) -> list:
     return problems
 
 
+def check_autopilot_policy(spec) -> list:
+    """(code, message) problems in an online SLO autopilot spec (rule
+    code AIKO412).  Same shape as check_gateway_policy: the
+    per-directive grammar check, then the REAL AutopilotPolicy.parse
+    so cross-field constraints (burn_window > 0, max_delta_frac > 0)
+    fail offline exactly as Gateway construction would."""
+    from ..serve.autopilot import AUTOPILOT_GRAMMAR, AutopilotPolicy
+    problems = AUTOPILOT_GRAMMAR.check(spec, value_code="AIKO412")
+    if not problems:
+        try:
+            AutopilotPolicy.parse(spec)
+        except ValueError as error:
+            problems.append(("AIKO412", str(error)))
+    return problems
+
+
 def check_federation_policy(spec) -> list:
     """(code, message) problems in a federated-gateway spec.  Same
     shape as check_gateway_policy: the per-directive grammar check as
@@ -479,6 +495,13 @@ def run_policy_pass(definition) -> AnalysisReport:
     journal_spec = (definition.parameters or {}).get("journal_policy")
     if journal_spec:
         for code, message in check_journal_policy(journal_spec):
+            report.add(Diagnostic(code, message, definition=name))
+    # `autopilot_policy` is the gateway-side online-tuning loop spec
+    # embedded next to the definition (serve/autopilot.py)
+    autopilot_spec = (definition.parameters or {}).get(
+        "autopilot_policy")
+    if autopilot_spec:
+        for code, message in check_autopilot_policy(autopilot_spec):
             report.add(Diagnostic(code, message, definition=name))
     # `federation_policy` is the gateway-side federated-tier spec
     # embedded next to the definition (stream -> group consistent hash)

@@ -422,7 +422,18 @@ def deadletter_replay(index: int, gateway: str | None,
 
 
 @main.command()
-@click.argument("trace", type=click.Path(exists=True))
+@click.argument("trace", type=click.Path(exists=True), required=False)
+@click.option("--live", default=None, metavar="TOPIC",
+              help="Tune from a LIVE wire harvest instead of a trace "
+                   "artifact: publish_trace the service at TOPIC "
+                   "(a gateway or pipeline topic path), or pass "
+                   "'discover' to harvest every discovered "
+                   "gateway/pipeline -- the same harvest+merge path "
+                   "the gateway autopilot runs each tick")
+@click.option("--transport", default=None,
+              help="Transport for --live (default: AIKO_TRANSPORT)")
+@click.option("--wait", default=3.0,
+              help="Discovery/response wait for --live (s)")
 @click.option("--slo", default="throughput",
               help="SLO directive: 'throughput', 'latency', or a "
                    "spec like 'slo=throughput;p99_ms=250' "
@@ -452,8 +463,9 @@ def deadletter_replay(index: int, gateway: str | None,
               help="Skip the static FLOP/byte estimation (no element "
                    "instantiation -- faster; achieved-utilization "
                    "evidence is omitted)")
-def tune(trace, slo, as_json, output, definition_path, run_name,
-         apply_path, what_if, no_flops) -> None:
+def tune(trace, live, transport, wait, slo, as_json, output,
+         definition_path, run_name, apply_path, what_if,
+         no_flops) -> None:
     """Profile-guided pipeline optimizer: classify each element's
     dominant floor (dispatch / compute / queue / compile-bound) from a
     recorded trace joined against the static graph, recommend concrete
@@ -461,9 +473,10 @@ def tune(trace, slo, as_json, output, definition_path, run_name,
     hardware needed (tune/ subsystem, README "Performance tuning").
 
     TRACE is a Perfetto artifact from `bench.py --trace` or
-    PipelineTelemetry.export_trace.  Exit status: 0 report produced,
-    1 --apply produced a definition that fails lint, 2 the trace
-    cannot be joined (no metadata and no --definition).
+    PipelineTelemetry.export_trace; `--live TOPIC` harvests one over
+    the wire instead.  Exit status: 0 report produced, 1 --apply
+    produced a definition that fails lint, 2 the trace cannot be
+    joined (no metadata and no --definition) or not harvested.
     """
     import sys
     from pathlib import Path
@@ -472,6 +485,17 @@ def tune(trace, slo, as_json, output, definition_path, run_name,
     from .tune import (
         SloSpec, TraceLoadError, render_report, report_json, run_tune)
 
+    if (trace is None) == (live is None):
+        click.echo("give exactly one trace source: a TRACE artifact "
+                   "path or --live TOPIC", err=True)
+        sys.exit(2)
+    if live is not None and what_if is not None:
+        # what-if replays a SPECIFIC recorded trace under explicit
+        # settings; a live harvest is point-in-time and unrepeatable,
+        # so the comparison would be against a moving target
+        click.echo("--what-if needs a trace artifact (record one with "
+                   "bench.py --trace), not --live", err=True)
+        sys.exit(2)
     if what_if is not None and apply_path is not None:
         # --what-if scores EXPLICIT settings (no recommender), so
         # there is nothing to apply -- silently ignoring --apply
@@ -488,7 +512,44 @@ def tune(trace, slo, as_json, output, definition_path, run_name,
     static_costs = {} if no_flops else None
     loaded = None
     try:
-        if what_if is not None:
+        if live is not None:
+            # the gateway autopilot's exact harvest+merge+tune path
+            # (serve/autopilot.py), run once from the shell: wire-
+            # harvest, merge, tune -- no artifact file ever written
+            from .runtime import Process
+            from .serve.autopilot import harvest_documents, \
+                tune_documents
+            process = Process(transport_kind=transport)
+            process.run(in_thread=True)
+            try:
+                targets = None if live == "discover" else [live]
+                named = harvest_documents(process, wait=wait,
+                                          targets=targets)
+            finally:
+                process.terminate()
+            if not named:
+                click.echo(
+                    f"no traces harvested: nothing answered "
+                    f"publish_trace within {wait:g}s "
+                    f"({'discovery' if live == 'discover' else live})",
+                    err=True)
+                sys.exit(2)
+            if apply_path is not None:
+                # one parse serves both the report and the apply
+                from .observe import merge_trace_documents
+                from .tune import load_trace
+                loaded = load_trace(
+                    "live", definition=definition_path, run=run_name,
+                    document=merge_trace_documents(list(named)))
+                report = run_tune("live", slo_spec=slo_spec,
+                                  loaded=loaded,
+                                  static_costs=static_costs)
+            else:
+                report = tune_documents(
+                    named, slo_spec=slo_spec,
+                    definition=definition_path, run=run_name,
+                    static_costs=static_costs)
+        elif what_if is not None:
             report = _tune_what_if(trace, slo_spec, definition_path,
                                    run_name, what_if,
                                    static_costs=static_costs)

@@ -130,12 +130,36 @@ def _gateway_plugin(model: "DashboardModel") -> list:
             if not isinstance(record, dict):
                 continue
             attainment = record.get("attainment")
-            parts.append(
+            part = (
                 f"p{priority} {attainment if attainment is not None else '?'}"
                 f" ({record.get('ok', 0)}/{record.get('miss', 0)} "
                 f"ok/miss)")
+            if record.get("burn_window") is not None:
+                # sliding-window burn (autopilot gate input): the
+                # miss fraction over the LAST window only, not the
+                # lifetime ratio attainment reports
+                part += f" burn {record.get('burn_window')}"
+            parts.append(part)
         if parts:
             lines.append("slo: " + "  ".join(parts))
+    autopilot = metrics.get("autopilot")
+    if isinstance(autopilot, dict):
+        convergence = autopilot.get("convergence")
+        autopilot_line = (
+            f"autopilot: {'apply' if autopilot.get('apply') else 'dry-run'}"
+            f"/{autopilot.get('scope', 'local')}  "
+            f"deltas {autopilot.get('deltas_applied', 0)} applied "
+            f"{autopilot.get('deltas_clamped', 0)} clamped "
+            f"{autopilot.get('deltas_skipped', 0)} skipped  "
+            f"backoffs {autopilot.get('backoffs', 0)}")
+        if convergence is not None:
+            autopilot_line += (
+                f"  convergence {convergence}"
+                f"{' (converged)' if autopilot.get('converged') else ''}")
+        if autopilot.get("rebalances"):
+            autopilot_line += (
+                f"  rebalances {autopilot.get('rebalances')}")
+        lines.append(autopilot_line)
     decomposition = metrics.get("stream_decomposition")
     if isinstance(decomposition, dict):
         total = decomposition.get("_total")

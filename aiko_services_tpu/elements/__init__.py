@@ -4,8 +4,8 @@ from .text_io import (                                        # noqa: F401
     TextReadFile, TextSource, TextTransform, TextSample, TextWriteFile,
     TextOutput)
 from .toys import (                                           # noqa: F401
-    PE_Number, PE_Add, PE_Multiply, PE_Sum2, PE_Inspect, PE_Metrics,
-    PE_RandomIntegers, PE_RandomTensor, PE_Sum)
+    PE_Number, PE_Add, PE_Busy, PE_Multiply, PE_Sum2, PE_Inspect,
+    PE_Metrics, PE_RandomIntegers, PE_RandomTensor, PE_Sum)
 from .compute import (                                        # noqa: F401
     ArraySource, TokenSource, MultiModalSource, JaxScale, JaxMLP, ToHost)
 from .ml import (                                             # noqa: F401

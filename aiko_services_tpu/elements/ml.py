@@ -907,6 +907,20 @@ class LMGenerate(ComputeElement):
             for completion in report.completions:
                 self._finish_request(completion)
             if getattr(self, "_checkpointer", None) is not None:
+                # live cadence override: the gateway autopilot retunes
+                # `checkpoint_every` via set_element_parameter, so the
+                # policy is re-read each step (wire values arrive as
+                # strings) and takes effect on the NEXT cadence tick --
+                # never a checkpointer rebuild, never a restart
+                cadence = self.get_parameter("checkpoint_every")
+                if cadence is not None:
+                    try:
+                        cadence = int(cadence)
+                    except (TypeError, ValueError):
+                        cadence = None
+                if cadence is not None and cadence > 0 and cadence \
+                        != self._checkpointer.policy.checkpoint_every:
+                    self._checkpointer.policy.checkpoint_every = cadence
                 # one cadence tick per engine step; tick() never raises
                 # (a failed snapshot keeps the keeper's previous one)
                 self._checkpointer.tick()

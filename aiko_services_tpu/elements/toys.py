@@ -11,8 +11,9 @@ from ..pipeline import PipelineElement, StreamEvent
 from ..utils import get_logger
 from .common_io import DataSource
 
-__all__ = ["PE_Number", "PE_Add", "PE_Multiply", "PE_Sum2", "PE_Inspect",
-           "PE_Metrics", "PE_RandomIntegers", "PE_RandomTensor", "PE_Sum"]
+__all__ = ["PE_Number", "PE_Add", "PE_Busy", "PE_Multiply", "PE_Sum2",
+           "PE_Inspect", "PE_Metrics", "PE_RandomIntegers",
+           "PE_RandomTensor", "PE_Sum"]
 
 _LOGGER = get_logger("toys")
 
@@ -33,6 +34,25 @@ class PE_Add(PipelineElement):
 class PE_Multiply(PipelineElement):
     def process_frame(self, stream, number):
         constant = int(self.get_parameter("constant", 2, stream))
+        return StreamEvent.OKAY, {"number": int(number) * constant}
+
+
+class PE_Busy(PipelineElement):
+    """PE_Multiply with a FIXED host cost per frame (`work_ms`): models
+    a replica's service time, so capacity-sensitive benches and tests
+    control the floor classification (compute vs queue wait) instead of
+    the host machine.  Output stays deterministic (number x constant)
+    for bit-identical two-arm comparisons.  Array inputs multiply
+    elementwise (shape-preserving), so the element coalesces under
+    micro-batching; scalar ints stay exact integers."""
+
+    def process_frame(self, stream, number):
+        import time
+        time.sleep(  # the modelled service time  # aiko: allow
+            float(self.get_parameter("work_ms", 2, stream)) / 1000.0)
+        constant = int(self.get_parameter("constant", 3, stream))
+        if hasattr(number, "shape"):
+            return StreamEvent.OKAY, {"number": number * constant}
         return StreamEvent.OKAY, {"number": int(number) * constant}
 
 

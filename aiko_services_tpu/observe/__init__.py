@@ -6,8 +6,9 @@
 # glue and the only module that knows what a Pipeline is.
 
 from .metrics import (                                      # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry, get_registry,
-    merge_snapshots, snapshot_from_wire, snapshot_quantile)
+    Counter, Gauge, Histogram, MetricsRegistry, SlidingWindow,
+    get_registry, merge_snapshots, snapshot_from_wire,
+    snapshot_quantile)
 from .trace import (                                        # noqa: F401
     FrameTrace, TRACE_CONTEXT_KEY, Tracer, attach_trace_context,
     chrome_trace_document, clock_epoch_unix_us,

@@ -350,28 +350,30 @@ def trace_summary(document: dict) -> dict:
 
 
 def collect_traces(process, wait: float = 3.0,
-                   protocols: tuple = ("pipeline", "gateway")) -> dict:
+                   protocols: tuple = ("pipeline", "gateway"),
+                   targets=None) -> dict:
     """Harvest live per-process trace documents over the control
     plane: discover every pipeline/gateway service through the shared
-    ServicesCache, send each `(publish_trace <response_topic>)`, and
-    gather the `(trace <source> <document>)` replies for `wait`
-    seconds.  Returns {source_topic_path: document} -- feed
-    `.items()` (sorted) to merge_trace_documents."""
+    ServicesCache (or query the explicit `targets` topic paths and
+    skip discovery), send each `(publish_trace <response_topic>)`,
+    and gather the `(trace <source> <document>)` replies.  Returns
+    {source_topic_path: document} -- feed `.items()` (sorted) to
+    merge_trace_documents.
+
+    `wait` is a DEADLINE, not a sleep: once every queried service has
+    replied the collector returns immediately (a healthy fleet pays
+    round-trip latency, not the timeout).  `collector.responses` /
+    `collector.timeouts` counters in the process-global registry make
+    partial harvests visible instead of silent."""
     import threading
 
-    from ..runtime import ServiceFilter
-    from ..runtime.service import SERVICE_PROTOCOL_PIPELINE
-    from ..runtime.share import services_cache_create_singleton
-    from ..serve import SERVICE_PROTOCOL_GATEWAY
     from ..utils import generate, parse
+    from .metrics import get_registry
 
-    wanted = {
-        "pipeline": SERVICE_PROTOCOL_PIPELINE,
-        "gateway": SERVICE_PROTOCOL_GATEWAY,
-    }
     response_topic = f"{process.topic_path_process}/trace_collect"
     collected: dict = {}
     lock = threading.Lock()
+    registry = get_registry()
 
     def on_trace(topic, payload):
         try:
@@ -389,28 +391,63 @@ def collect_traces(process, wait: float = 3.0,
                 return
         if isinstance(document, dict):
             with lock:
+                if source not in collected:
+                    registry.counter("collector.responses").inc()
                 collected[source] = document
 
     process.add_message_handler(on_trace, response_topic)
-    cache = services_cache_create_singleton(process)
-    targets: set = set()
-
-    def handler(command, fields):
-        if command == "add" and fields.topic_path not in targets:
-            targets.add(fields.topic_path)
-            process.publish(f"{fields.topic_path}/in",
-                            generate("publish_trace", [response_topic]))
-
+    queried: set = set()
     handlers = []
-    for kind in protocols:
-        protocol = wanted.get(kind)
-        if protocol is None:
-            continue
-        service_filter = ServiceFilter(protocol=protocol)
-        cache.add_handler(handler, service_filter)
-        handlers.append((handler, service_filter))
+    cache = None
+    if targets is not None:
+        for topic_path in targets:
+            topic_path = str(topic_path)
+            if topic_path not in queried:
+                queried.add(topic_path)
+                process.publish(
+                    f"{topic_path}/in",
+                    generate("publish_trace", [response_topic]))
+    else:
+        from ..runtime import ServiceFilter
+        from ..runtime.service import SERVICE_PROTOCOL_PIPELINE
+        from ..runtime.share import services_cache_create_singleton
+        from ..serve import SERVICE_PROTOCOL_GATEWAY
+        wanted = {
+            "pipeline": SERVICE_PROTOCOL_PIPELINE,
+            "gateway": SERVICE_PROTOCOL_GATEWAY,
+        }
+        cache = services_cache_create_singleton(process)
+
+        def handler(command, fields):
+            if command == "add" and fields.topic_path not in queried:
+                queried.add(fields.topic_path)
+                process.publish(
+                    f"{fields.topic_path}/in",
+                    generate("publish_trace", [response_topic]))
+
+        for kind in protocols:
+            protocol = wanted.get(kind)
+            if protocol is None:
+                continue
+            service_filter = ServiceFilter(protocol=protocol)
+            cache.add_handler(handler, service_filter)
+            handlers.append((handler, service_filter))
     import time as _time
-    _time.sleep(max(wait, 0.0))
+    start = _time.monotonic()
+    deadline = start + max(wait, 0.0)
+    # early return needs a CLOSED respondent set: explicit targets are
+    # closed by construction; under discovery the set only grows, so a
+    # short grace keeps a service registering right behind the first
+    # batch from being cut off before it is even queried
+    grace = 0.0 if targets is not None else min(max(wait, 0.0), 0.5)
+    while _time.monotonic() < deadline:
+        with lock:
+            answered = len(collected)
+        expected = len(queried)
+        if expected and answered >= expected \
+                and _time.monotonic() - start >= grace:
+            break
+        _time.sleep(0.01)
     for added, _filter in handlers:
         try:
             cache.remove_handler(added)
@@ -418,4 +455,7 @@ def collect_traces(process, wait: float = 3.0,
             pass
     process.remove_message_handler(on_trace, response_topic)
     with lock:
+        missing = len(queried) - len(collected)
+        if missing > 0:
+            registry.counter("collector.timeouts").inc(missing)
         return dict(collected)

@@ -23,8 +23,8 @@
 from __future__ import annotations
 
 
-from ..utils import get_logger
-from .metrics import MetricsRegistry
+from ..utils import get_logger, monotonic
+from .metrics import MetricsRegistry, SlidingWindow
 from .trace import Tracer, now_us, to_us, trace_metadata
 
 __all__ = ["GatewayTelemetry"]
@@ -35,6 +35,9 @@ DEFAULT_METRICS_INTERVAL = 10.0
 # per-stream end-to-end decomposition entries kept in the summary: the
 # EC share is a compact view, not a database (totals always ride)
 DECOMPOSITION_STREAM_CAP = 32
+# default sliding window for SLO burn: long enough to smooth one slow
+# frame, short enough that the dashboard row is a LIVE health signal
+DEFAULT_BURN_WINDOW_S = 60.0
 
 
 class GatewayTelemetry:
@@ -110,6 +113,14 @@ class GatewayTelemetry:
             "gateway.journal_replayed")
         self.journal_dropped_stale = registry.counter(
             "gateway.journal_dropped_stale")
+        # windowed SLO burn (observe/metrics.SlidingWindow): the
+        # cumulative attainment/burn ratio goes stale as a health
+        # signal on long runs, so the autopilot gate and the dashboard
+        # `slo:` row both read burn over THIS window instead
+        self.slo_window = SlidingWindow(DEFAULT_BURN_WINDOW_S)
+        # per-tick summary the serve/autopilot.py loop stages for the
+        # EC share (None until an autopilot is attached and has ticked)
+        self.autopilot_summary: dict | None = None
         self._interval = interval
         self._timer = None
         if self.enabled and interval > 0:
@@ -251,10 +262,52 @@ class GatewayTelemetry:
             self.registry.counter(
                 f"gateway.slo_miss:p{priority}").inc()
 
+    def configure_slo_window(self, window_s: float) -> None:
+        """Re-window the burn accounting (the autopilot aligns it with
+        its policy's burn_window).  Existing samples are discarded --
+        a window change is a new measurement, not a rescale."""
+        self.slo_window = SlidingWindow(max(float(window_s), 1e-9))
+
+    def sample_slo_window(self, now: float | None = None) -> None:
+        """Feed the cumulative slo_ok/slo_miss counters into the
+        sliding window.  Called from the snapshot timer and from the
+        autopilot immediately before it reads the gate, so the window
+        is fresh at decision time."""
+        values = {name: counter.value
+                  for name, counter in list(
+                      self.registry._counters.items())
+                  if name.startswith(("gateway.slo_ok:p",
+                                      "gateway.slo_miss:p"))}
+        self.slo_window.sample(monotonic() if now is None else now,
+                               values)
+
+    def windowed_burn(self, priority=None) -> float | None:
+        """Burn rate miss/(ok+miss) over the sliding window -- across
+        ALL priorities by default, or one priority bucket.  None when
+        the window saw no judged traffic (no signal != zero burn)."""
+        if priority is not None:
+            return self.slo_window.burn(
+                f"gateway.slo_miss:p{priority}",
+                f"gateway.slo_ok:p{priority}")
+        ok = miss = 0.0
+        if len(self.slo_window._samples) < 2:
+            return None
+        for name in self.slo_window._samples[-1][2]:
+            if name.startswith("gateway.slo_miss:p"):
+                miss += self.slo_window.delta(name)
+            elif name.startswith("gateway.slo_ok:p"):
+                ok += self.slo_window.delta(name)
+        total = ok + miss
+        if total <= 0:
+            return None
+        return miss / total
+
     def slo_summary(self) -> dict:
-        """Per-priority {ok, miss, attainment, burn}: attainment is the
-        in-SLO fraction, burn the complement (the error-budget burn
-        fraction)."""
+        """Per-priority {ok, miss, attainment, burn, burn_window}:
+        attainment is the in-SLO fraction, burn its cumulative
+        complement (the error-budget burn fraction), burn_window the
+        SAME ratio over the sliding window only (absent when the
+        window saw no judged traffic)."""
         buckets: dict[str, dict] = {}
         snapshot = self.registry.snapshot()
         for name, value in (snapshot.get("counters") or {}).items():
@@ -264,12 +317,15 @@ class GatewayTelemetry:
                     priority = name[len(prefix):]
                     buckets.setdefault(priority, {"ok": 0, "miss": 0})[
                         kind] = int(value)
-        for record in buckets.values():
+        for priority, record in buckets.items():
             judged = record["ok"] + record["miss"]
             record["attainment"] = round(
                 record["ok"] / judged, 4) if judged else None
             record["burn"] = round(
                 record["miss"] / judged, 4) if judged else None
+            windowed = self.windowed_burn(priority)
+            if windowed is not None:
+                record["burn_window"] = round(windowed, 4)
         # numeric priority order (p2 before p10), odd keys last
         return dict(sorted(
             buckets.items(),
@@ -374,11 +430,14 @@ class GatewayTelemetry:
             if self.last_takeover_ms is not None:
                 ha["takeover_ms"] = self.last_takeover_ms
             summary["ha"] = ha
+        if self.autopilot_summary is not None:
+            summary["autopilot"] = self.autopilot_summary
         return summary
 
     def _publish_snapshot(self) -> None:
         gateway = self.gateway
         try:
+            self.sample_slo_window()
             from ..utils import generate
             gateway.process.publish(
                 f"{gateway.topic_path}/metrics",

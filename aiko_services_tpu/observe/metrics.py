@@ -26,13 +26,14 @@
 from __future__ import annotations
 
 from bisect import bisect_left
+from collections import deque
 
 from ..utils import generate, parse_number
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "get_registry", "merge_snapshots", "parse_metrics_payload",
-    "snapshot_from_wire", "snapshot_quantile",
+    "SlidingWindow", "get_registry", "merge_snapshots",
+    "parse_metrics_payload", "snapshot_from_wire", "snapshot_quantile",
 ]
 
 # Geometric bucket ladder for timing histograms: 10 us doubling up to
@@ -114,6 +115,80 @@ class Histogram:
                 "min": self.low if self.low is not None else 0.0,
                 "max": self.high if self.high is not None else 0.0,
                 "buckets": list(self.buckets)}
+
+
+class SlidingWindow:
+    """Windowed deltas of CUMULATIVE counters over N-second buckets.
+
+    Counters only grow, so "burn over the last W seconds" needs a
+    baseline: sample() records the current cumulative values into a
+    coarse bucket ring (one retained sample per `bucket_s` slot), and
+    delta() reads latest-minus-trailing-edge.  Both the autopilot's
+    act/back-off gate and the dashboard `slo:` row consume this -- the
+    cumulative-since-start ratio goes stale as a health signal on long
+    runs (an hour of 100% attainment hides a minute of 0%).
+
+    The caller supplies `now` (monotonic seconds), like TokenBucket, so
+    tests drive the window deterministically.  Samples older than the
+    window are pruned except the newest one at-or-before the trailing
+    edge, which serves as the baseline."""
+
+    __slots__ = ("window_s", "bucket_s", "_samples")
+
+    def __init__(self, window_s: float = 60.0,
+                 bucket_s: float | None = None):
+        self.window_s = max(float(window_s), 1e-9)
+        # ~12 buckets per window by default: coarse enough that a
+        # per-frame sampler costs nothing, fine enough that the window
+        # edge moves smoothly
+        self.bucket_s = (max(float(bucket_s), 1e-9)
+                         if bucket_s is not None
+                         else max(self.window_s / 12.0, 1e-9))
+        self._samples: deque = deque()   # (bucket, now, {name: value})
+
+    def sample(self, now: float, values: dict) -> None:
+        """Record cumulative `values` at time `now`.  Within one bucket
+        slot the LATEST sample wins (the slot's closing totals)."""
+        now = float(now)
+        bucket = int(now // self.bucket_s)
+        snapshot = {name: float(value)
+                    for name, value in values.items()}
+        if self._samples and self._samples[-1][0] == bucket:
+            self._samples[-1] = (bucket, now, snapshot)
+        else:
+            self._samples.append((bucket, now, snapshot))
+        edge = now - self.window_s
+        while len(self._samples) >= 2 and self._samples[1][1] <= edge:
+            self._samples.popleft()
+
+    def delta(self, name: str) -> float:
+        """latest - baseline for one counter; 0.0 with fewer than two
+        samples (no window to difference yet) or an unseen name."""
+        if len(self._samples) < 2:
+            return 0.0
+        latest = self._samples[-1][2].get(name, 0.0)
+        baseline = self._samples[0][2].get(name, 0.0)
+        return max(latest - baseline, 0.0)
+
+    def span(self) -> float:
+        """Seconds actually covered (<= window_s during warm-up)."""
+        if len(self._samples) < 2:
+            return 0.0
+        return self._samples[-1][1] - self._samples[0][1]
+
+    def rate(self, name: str) -> float:
+        span = self.span()
+        return self.delta(name) / span if span > 0 else 0.0
+
+    def burn(self, miss_name: str, ok_name: str) -> float | None:
+        """Windowed burn rate miss/(ok+miss); None when the window saw
+        no traffic at all (no signal is different from zero burn)."""
+        miss = self.delta(miss_name)
+        ok = self.delta(ok_name)
+        total = ok + miss
+        if total <= 0:
+            return None
+        return miss / total
 
 
 def snapshot_quantile(snapshot: dict, q: float,

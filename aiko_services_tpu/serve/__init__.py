@@ -27,3 +27,6 @@ from .gateway import Gateway, SERVICE_PROTOCOL_GATEWAY    # noqa: F401
 from .autoscale import (                                  # noqa: F401
     AutoScaler, InProcessReplicaFactory, ProcessReplicaFactory,
     ScalePolicy)
+from .autopilot import (                                  # noqa: F401
+    AUTOPILOT_GRAMMAR, AutoPilot, AutopilotPolicy,
+    harvest_documents, tune_documents)

@@ -256,7 +256,7 @@ class Gateway(Actor):
                  metrics_interval: float = 10.0, autoscale=None,
                  replica_factory=None, journal=None, ha=None,
                  disagg=None, checkpoint=None, federation=None,
-                 prefix=None):
+                 prefix=None, autopilot=None):
         super().__init__(process, name, protocol=SERVICE_PROTOCOL_GATEWAY)
         # construction-time validation through the shared
         # directive-grammar core (analyze/grammar.py): a typo'd policy
@@ -347,6 +347,24 @@ class Gateway(Actor):
             raise ValueError(
                 f"{code}: gateway prefix policy rejected: "
                 f"{error}") from None
+        # online SLO autopilot (serve/autopilot.py): with an autopilot
+        # policy set, the gateway runs the observe -> tune -> apply
+        # loop on a cadence -- live trace harvest, bounded deltas
+        # through the live setters below, every apply write-ahead
+        # journaled.  apply=off (the default) is a dry-run audit.
+        # The attribute exists BEFORE the parse: stop() on a process
+        # torn down after a rejected spec must find it
+        self.autopilot = None
+        try:
+            from .autopilot import AutopilotPolicy
+            self.autopilot_policy = (AutopilotPolicy.parse(autopilot)
+                                     if autopilot is not None else None)
+        except ValueError as error:
+            code = ("AIKO404" if getattr(error, "kind", "") == "unknown"
+                    else "AIKO412")
+            raise ValueError(
+                f"{code}: gateway autopilot policy rejected: "
+                f"{error}") from None
         self.federation_group = None
         if self.federation is not None and self.federation.groups:
             self.federation_group = (self.federation.group
@@ -384,6 +402,7 @@ class Gateway(Actor):
         self._services_cache = None
         self._discovery_handler = None
         self.autoscaler = None
+        self.autopilot = None
         # -- crash consistency (serve/journal.py): a journaled gateway
         # rebuilds pins/cursors/dedupe floors after a crash; an HA
         # group member additionally runs the registrar-style retained
@@ -450,6 +469,13 @@ class Gateway(Actor):
                 self.journal_policy.replay_timeout_s)
         if autoscale is not None:
             self.enable_autoscale(autoscale, replica_factory)
+        if self.autopilot_policy is not None:
+            from .autopilot import AutoPilot
+            self.autopilot = AutoPilot(self, self.autopilot_policy)
+            if not self.ha_group:
+                # HA members arm the loop on promote only: a standby
+                # must never tune a fleet it does not own
+                self.autopilot.start()
 
     def _post_message(self, actor_topic: str, command: str,
                       parameters) -> None:
@@ -534,6 +560,8 @@ class Gateway(Actor):
         started = time.monotonic()
         adopted = self._adopt_journal()
         self._start_journal_tick()
+        if self.autopilot is not None:
+            self.autopilot.start()
         takeover_ms = (time.monotonic() - started) * 1000.0
         if was_standby and self._ha_was_secondary:
             # promotion after standing by = a real takeover (a cold
@@ -555,6 +583,8 @@ class Gateway(Actor):
         if self.ec_producer is not None:
             self.ec_producer.update("role", self.role)
         self._stop_journal_tick()
+        if self.autopilot is not None:
+            self.autopilot.stop()
         _LOGGER.warning("%s: demoted to HA standby (%s)", self.name,
                         self.ha_group)
 
@@ -691,6 +721,14 @@ class Gateway(Actor):
         records, buckets, dropped = self.journal.replay()
         if dropped:
             self.telemetry.journal_dropped_stale.inc(dropped)
+        if self.autopilot is not None:
+            # autopilot config deltas replay FIRST (and on every
+            # adoption pass -- absolute values make re-application
+            # idempotent, and the deferred empty-pool retry below needs
+            # the second pass to reach late-attaching replicas): the
+            # adopted streams must land on the exact knob settings the
+            # previous primary had applied
+            self.autopilot.adopt_journal()
         if records and not any(not replica.dead
                                for replica in self.replicas.values()):
             # cold start after a FULL outage: the pool is empty because
@@ -1941,7 +1979,92 @@ class Gateway(Actor):
         self._journal_forget(stream.stream_id)
         self._update_share()
 
+    # -- live reconfiguration (the autopilot's apply surface) --------------
+    #
+    # Every setter mutates the RUNNING configuration in place -- no
+    # restart, no stream disruption, no recompile.  serve/autopilot.py
+    # write-ahead journals each delta before calling these, so a crash
+    # mid-apply replays into the identical state.
+
+    def set_bucket_rate(self, priority, rate, burst=None) -> None:
+        """Live-retune (or create) one admission token bucket.  The
+        current token level is preserved (clamped to a shrunk burst):
+        a rate change must not refund or confiscate in-flight budget."""
+        from .policy import TokenBucket
+        priority = int(priority)
+        rate = max(float(rate), 1e-9)
+        bucket = self.policy.buckets.get(priority)
+        if bucket is None:
+            self.policy.buckets[priority] = TokenBucket(
+                rate, float(burst) if burst else max(rate, 1.0))
+        else:
+            bucket.rate = rate
+            if burst:
+                bucket.burst = float(burst)
+                bucket.tokens = min(bucket.tokens, bucket.burst)
+        if self.journal is not None and self.role != "standby":
+            self._buckets_dirty = True
+
+    def set_autoscale_floors(self, min_replicas=None,
+                             max_replicas=None) -> None:
+        """Live-move the autoscaler's floor/ceiling; the next scaler
+        tick acts on the new bounds.  The min <= max invariant is kept
+        by widening toward whichever side the caller moved."""
+        if self.autoscaler is None:
+            return
+        floors = self.autoscaler.policy
+        if max_replicas is not None:
+            floors.max_replicas = max(int(max_replicas), 1)
+        if min_replicas is not None:
+            floors.min_replicas = max(int(min_replicas), 1)
+        if floors.min_replicas > floors.max_replicas:
+            if min_replicas is not None and max_replicas is None:
+                floors.max_replicas = floors.min_replicas
+            else:
+                floors.min_replicas = floors.max_replicas
+
+    def set_replica_parameter(self, element_name, name, value) -> int:
+        """Broadcast one element-parameter change to every live
+        replica: direct-attached pipelines take the in-process call,
+        wire replicas get `(set_element_parameter ...)` on their `in`
+        topic.  Parameters like micro_batch / checkpoint_every are
+        re-read per batch flush / checkpoint tick, so the new value
+        takes effect on the next frame without a restart."""
+        updated = 0
+        for replica in self.replicas.values():
+            if replica.dead or replica.draining:
+                continue
+            if replica.pipeline is not None:
+                try:
+                    replica.pipeline.set_element_parameter(
+                        element_name, name, value)
+                    updated += 1
+                except Exception as error:
+                    _LOGGER.warning(
+                        "%s: set %s.%s on %s failed: %s", self.name,
+                        element_name, name, replica.name, error)
+            else:
+                self.process.publish(
+                    f"{replica.topic_path}/in",
+                    generate("set_element_parameter",
+                             [str(element_name), str(name),
+                              str(value)]))
+                updated += 1
+        return updated
+
     # -- observability -----------------------------------------------------
+
+    def _autopilot_collect(self) -> None:
+        """Mailbox continuation of the autopilot cadence timer."""
+        if self.autopilot is not None:
+            self.autopilot.collect()
+
+    def _autopilot_decide(self, round_id) -> None:
+        """Mailbox continuation closing one autopilot harvest round
+        (posted early when every respondent answered, else by the
+        wait lease)."""
+        if self.autopilot is not None:
+            self.autopilot.decide(round_id)
 
     def publish_trace(self, topic_response) -> None:
         """Wire query (`aiko trace collect`): publish this gateway's
@@ -1987,6 +2110,14 @@ class Gateway(Actor):
             self.ec_producer.stage("role", self.role)
 
     def stop(self) -> None:
+        if not hasattr(self, "election"):
+            # construction raised before wiring completed (a rejected
+            # policy spec): process teardown finds nothing to stop --
+            # every constructor raise precedes the election attribute
+            return
+        if self.autopilot is not None:
+            self.autopilot.shutdown()
+            self.autopilot = None
         if self.autoscaler is not None:
             self.autoscaler.stop()
             self.autoscaler = None

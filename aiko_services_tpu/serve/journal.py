@@ -133,6 +133,12 @@ class JournalPolicy:
                 f"path={self.path!r}, interval={self.interval_s})")
 
 
+def _delta_key(delta: dict) -> str:
+    """Zero-padded sequence key: lexical order IS apply order, so both
+    backends replay deltas exactly as they were applied."""
+    return f"autopilot/{int(delta.get('seq', 0)):010d}"
+
+
 class _SqliteBackend:
     """Journal over the sqlite KV core shared with the Storage actor
     (runtime/storage.py KeyValueStore): stream records under
@@ -152,6 +158,18 @@ class _SqliteBackend:
             items["buckets"] = buckets
         self.store.write_batch(
             items, [f"stream/{stream_id}" for stream_id in forgotten])
+
+    def write_deltas(self, deltas) -> None:
+        self.store.write_batch(
+            {_delta_key(delta): delta for delta in deltas}, [])
+
+    def replay_deltas(self) -> list:
+        return [record for _, record
+                in sorted(self.store.items("autopilot/"))]
+
+    def purge_deltas(self, seqs) -> None:
+        self.store.write_batch(
+            {}, [f"autopilot/{int(seq):010d}" for seq in seqs])
 
     def replay(self) -> tuple:
         records = [record for _, record in self.store.items("stream/")]
@@ -184,6 +202,7 @@ class _RetainedBackend:
         self._pattern = f"{root_topic}/#"
         self.mirror: dict[str, dict] = {}     # stream_id -> record
         self.bucket_mirror: dict = {}
+        self.delta_mirror: dict[int, dict] = {}   # seq -> delta record
         process.add_message_handler(self._on_message, self._pattern)
 
     def _on_message(self, topic: str, payload: str) -> None:
@@ -193,6 +212,19 @@ class _RetainedBackend:
                 self.bucket_mirror = json.loads(payload) if payload else {}
             except ValueError:
                 _LOGGER.warning("undecodable journal buckets payload")
+            return
+        if tail.startswith("autopilot/"):
+            try:
+                seq = int(tail[len("autopilot/"):])
+            except ValueError:
+                return
+            if not payload:
+                self.delta_mirror.pop(seq, None)
+                return
+            try:
+                self.delta_mirror[seq] = json.loads(payload)
+            except ValueError:
+                _LOGGER.warning("undecodable journal delta on %s", topic)
             return
         if not tail.startswith("stream/"):
             return
@@ -218,6 +250,25 @@ class _RetainedBackend:
             publish(f"{self.root_topic}/buckets",
                     json.dumps(buckets, separators=(",", ":")),
                     retain=True)
+
+    def write_deltas(self, deltas) -> None:
+        for delta in deltas:
+            seq = int(delta.get("seq", 0))
+            self.delta_mirror[seq] = delta
+            self.process.publish(
+                f"{self.root_topic}/{_delta_key(delta)}",
+                json.dumps(delta, separators=(",", ":")), retain=True)
+
+    def replay_deltas(self) -> list:
+        return [self.delta_mirror[seq]
+                for seq in sorted(self.delta_mirror)]
+
+    def purge_deltas(self, seqs) -> None:
+        for seq in seqs:
+            self.delta_mirror.pop(int(seq), None)
+            self.process.publish(
+                f"{self.root_topic}/autopilot/{int(seq):010d}", "",
+                retain=True)
 
     def replay(self) -> tuple:
         return list(self.mirror.values()), dict(self.bucket_mirror)
@@ -257,6 +308,7 @@ class GatewayJournal:
         self.ticks = 0            # write() calls that reached the backend
         self.compactions = 0
         self.compacted_entries = 0
+        self.delta_appends = 0    # autopilot deltas write-ahead logged
         self._ticks_since_compact = 0
 
     def write(self, records: dict, forgotten=(), buckets=None) -> int:
@@ -294,6 +346,27 @@ class GatewayJournal:
             _LOGGER.info("journal replay dropped %d expired stream(s)",
                          len(stale))
         return live, buckets, len(stale)
+
+    def write_deltas(self, deltas) -> int:
+        """WRITE-AHEAD log autopilot config deltas, synchronously and
+        BEFORE they are applied: a crash between the log and the apply
+        replays the logged value, a crash before the log never applied
+        anything -- either way replay reconstructs the exact applied
+        configuration.  Records carry absolute `value`s (never
+        increments), so replaying them twice is idempotent."""
+        deltas = [dict(delta) for delta in deltas]
+        if not deltas:
+            return 0
+        self.backend.write_deltas(deltas)
+        self.delta_appends += len(deltas)
+        return len(deltas)
+
+    def replay_deltas(self) -> list:
+        """Every journaled autopilot delta in apply (seq) order."""
+        return self.backend.replay_deltas()
+
+    def purge_deltas(self, seqs) -> None:
+        self.backend.purge_deltas(seqs)
 
     def compact(self) -> int:
         """Drop expired entries from the store (destroyed streams are

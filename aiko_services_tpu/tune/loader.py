@@ -158,23 +158,30 @@ def _select_run(metadata: dict, run: str | None, loaded: LoadedTrace):
 
 
 def load_trace(path: str, definition=None,
-               run: str | None = None) -> LoadedTrace:
+               run: str | None = None,
+               document: dict | None = None) -> LoadedTrace:
     """Load one trace artifact and join it against the static graph.
 
     `definition` (document/path/PipelineDefinition) is the side
     channel for metadata-absent traces; when BOTH are present the
-    explicit one wins and a fingerprint mismatch is diagnosed."""
+    explicit one wins and a fingerprint mismatch is diagnosed.
+
+    Pass `document` to load an IN-MEMORY Chrome-trace document (the
+    autopilot and `aiko tune --live` tune a live wire harvest without
+    an artifact file); `path` then only labels the report."""
     from ..pipeline.definition import (
         DefinitionError, PipelineDefinition, parse_pipeline_definition)
 
-    try:
-        with open(path) as handle:
-            document = json.load(handle)
-    except OSError as error:
-        raise TraceLoadError(f"cannot read trace {path}: {error}") \
-            from None
-    except ValueError as error:
-        raise TraceLoadError(f"{path} is not JSON: {error}") from None
+    if document is None:
+        try:
+            with open(path) as handle:
+                document = json.load(handle)
+        except OSError as error:
+            raise TraceLoadError(f"cannot read trace {path}: {error}") \
+                from None
+        except ValueError as error:
+            raise TraceLoadError(f"{path} is not JSON: {error}") \
+                from None
     if not isinstance(document, dict) \
             or not isinstance(document.get("traceEvents"), list):
         raise TraceLoadError(

@@ -2294,6 +2294,435 @@ def _chaos_decode_replica_kill(seed: int):
     return block
 
 
+# -- autopilot: the online SLO control loop (observe -> decide -> act) -------
+
+# one spec, three surfaces: the gateway policy the bench arms run, the
+# definition parameter `aiko lint --bench` checks (AIKO412), and the
+# published config block.  interval=0: the bench drives ticks itself
+# (tick_now / posted collects) instead of arming the wire timer, so
+# every run is deterministic
+_AUTOPILOT_POLICY = ("interval=0;apply=on;max_delta_frac=0.5;"
+                     "margin=0.15;burn_threshold=0.02")
+# the deliberately mis-tuned cold default the loop must walk back from,
+# and the value an operator hand-tunes for a closed-loop window of 2
+# (the recommender's fixed point: pow2 of the observed group occupancy)
+_AUTOPILOT_COLD_MICRO = 16
+_AUTOPILOT_TUNED_MICRO = 2
+
+
+def _autopilot_definition(name, micro=_AUTOPILOT_COLD_MICRO,
+                          work_ms=2):
+    """One fixed-host-cost element (PE_Busy) behind the gateway: the
+    autopilot scenario measures the CONTROL LOOP, not compute, and the
+    work_ms floor makes the queue-bound classification (starved
+    micro_batch groups) deterministic on any host.  Telemetry is
+    FORCED on: the trace harvest is the loop's input."""
+    return {
+        "name": name,
+        "parameters": {"telemetry": True, "metrics_interval": 60.0,
+                       "autopilot_policy": _AUTOPILOT_POLICY},
+        "graph": ["(busy)"],
+        "elements": [
+            # "any": the chaos arm feeds exact ints (bit-identical by
+            # construction), the convergence arm feeds f32 arrays (only
+            # array inputs coalesce under micro-batching)
+            {"name": "busy",
+             "input": [{"name": "number", "type": "any"}],
+             "output": [{"name": "number", "type": "any"}],
+             "parameters": {"micro_batch": micro,
+                            "micro_batch_wait_ms": 4,
+                            "work_ms": work_ms, "constant": 3},
+             "deploy": {"local": {"module": ELEMENTS,
+                                  "class_name": "PE_Busy"}}},
+        ],
+    }
+
+
+def _autopilot_replica_compiles(pipeline) -> int:
+    """Sum of every `pipeline.compiles_*` counter on one replica: the
+    no-recompile proof reads the delta across the apply window."""
+    registry = pipeline.telemetry.registry
+    return sum(counter.value
+               for name, counter in registry._counters.items()
+               if name.startswith("pipeline.compiles_"))
+
+
+def _autopilot_convergence_arm():
+    """Cold mis-tuned fleet -> deterministic tick_now() loop -> the
+    applied configuration must land within `margin` of the hand-tuned
+    settings, with every delta clamped/journal-accounted in the
+    per-tick ledger and ZERO replica recompiles in the apply window."""
+    import numpy as np
+
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process
+    from aiko_services_tpu.serve import Gateway
+    from aiko_services_tpu.transport import reset_brokers
+
+    total = 40 if SMOKE else 120
+    max_ticks = 12
+
+    def run_load(gateway, responses, start_frame, count):
+        """Closed-loop window-2 session traffic: the arrival pattern
+        that starves a micro_batch=16 group (median occupancy ~2).
+        Frames carry small float arrays -- ONLY array inputs coalesce
+        under micro-batching, and the starved-group queue wait IS the
+        signal the loop tunes on."""
+        submitted, done = 0, 0
+        start = time.perf_counter()
+
+        def push():
+            nonlocal submitted
+            gateway.submit_frame(
+                "s0",
+                {"number": np.full((1, 2), float(submitted),
+                                   np.float32)},
+                frame_id=start_frame + submitted)
+            submitted += 1
+
+        while submitted < min(2, count):
+            push()
+        outputs = {}
+        while done < count:
+            _, frame_id, out, status = responses.get(timeout=120)
+            done += 1
+            if status == "ok":
+                outputs[int(frame_id)] = float(
+                    np.asarray(out.get("number")).ravel()[0])
+            if submitted < count:
+                push()
+        return count / max(time.perf_counter() - start, 1e-9), outputs
+
+    def fleet(micro, autopilot):
+        process = Process(transport_kind="loopback")
+        pipeline = create_pipeline(
+            process, _autopilot_definition("bench_autopilot",
+                                           micro=micro))
+        gateway_process = Process(transport_kind="loopback")
+        gateway = Gateway(gateway_process,
+                          policy="max_inflight=64;queue=256",
+                          router_seed=7, telemetry=True,
+                          metrics_interval=60.0, autopilot=autopilot)
+        gateway.attach_replica(pipeline)
+        process.run(in_thread=True)
+        gateway_process.run(in_thread=True)
+        responses = queue.Queue()
+        gateway.submit_stream("s0", queue_response=responses)
+        return process, pipeline, gateway_process, gateway, responses
+
+    # arm 1: cold (mis-tuned micro_batch) + the live control loop
+    process, pipeline, gateway_process, gateway, responses = fleet(
+        _AUTOPILOT_COLD_MICRO, _AUTOPILOT_POLICY)
+    goodput_cold, cold_outputs = run_load(gateway, responses, 0, total)
+    compiles_before = _autopilot_replica_compiles(pipeline)
+    pilot = gateway.autopilot
+    ticks = 0
+    for _ in range(max_ticks):
+        pilot.tick_now()
+        ticks += 1
+        tick = pilot.ledger[-1] if pilot.ledger else {}
+        if tick.get("converged") and not tick.get("applied"):
+            break
+    compiles_in_window = (_autopilot_replica_compiles(pipeline)
+                          - compiles_before)
+    goodput_converged, converged_outputs = run_load(
+        gateway, responses, total, total)
+    micro_converged = pipeline.elements["busy"].get_parameter(
+        "micro_batch")
+    summary = pilot.summary()
+    ledger = [dict(tick) for tick in pilot.ledger]
+    gateway_process.terminate()
+    process.terminate()
+    reset_brokers()
+
+    # arm 2: the hand-tuned reference, no autopilot
+    process, pipeline, gateway_process, gateway, responses = fleet(
+        _AUTOPILOT_TUNED_MICRO, None)
+    goodput_tuned, tuned_outputs = run_load(gateway, responses, 0,
+                                            total)
+    gateway_process.terminate()
+    process.terminate()
+    reset_brokers()
+
+    return {
+        "frames_per_arm": total,
+        "micro_cold": _AUTOPILOT_COLD_MICRO,
+        "micro_hand_tuned": _AUTOPILOT_TUNED_MICRO,
+        "micro_converged": (int(micro_converged)
+                            if micro_converged is not None else None),
+        "ticks": ticks,
+        "converged": summary.get("converged", False),
+        "convergence": summary.get("convergence"),
+        "margin": pilot.policy.margin,
+        "deltas_applied": summary.get("deltas_applied", 0),
+        "deltas_clamped": summary.get("deltas_clamped", 0),
+        "deltas_skipped": summary.get("deltas_skipped", 0),
+        "compiles_in_window": compiles_in_window,
+        "goodput_cold_fps": round(goodput_cold, 1),
+        "goodput_converged_fps": round(goodput_converged, 1),
+        "goodput_hand_tuned_fps": round(goodput_tuned, 1),
+        "converged_vs_hand_tuned": round(
+            goodput_converged / max(goodput_tuned, 1e-9), 2),
+        # outputs are micro_batch-invariant by construction: retuning
+        # mid-fleet must never change WHAT is computed
+        "outputs_invariant": (
+            set(cold_outputs.values()) == set(tuned_outputs.values())
+            == set(converged_outputs.values())),
+        "ledger": ledger,
+    }
+
+
+def _autopilot_chaos_arm(seed: int):
+    """Seeded `process_kill` of the HA gateway primary in the apply
+    window: the standby promotes, adopts the retained delta journal
+    (every applied delta accounted, none re-applied), and the run's
+    per-frame outputs stay BIT-IDENTICAL to an unkilled reference with
+    frames_lost == 0."""
+    import threading
+
+    from aiko_services_tpu.faults import create_injector
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.pipeline.tensors import (
+        decode_frame_data, encode_frame_data)
+    from aiko_services_tpu.runtime import Process, Registrar
+    from aiko_services_tpu.serve import Gateway
+    from aiko_services_tpu.transport import reset_brokers
+    from aiko_services_tpu.utils import generate, parse
+
+    streams_n = 2 if SMOKE else 4
+    per_stream = 20 if SMOKE else 40
+    total = streams_n * per_stream
+    # first autopilot tick ~40%, kill in the apply window at ~70%
+    tick_frames = {max(2 * total // 5, 1), max(11 * total // 20, 2)}
+    kill_gateway = max(7 * total // 10, 3)
+    group = "autopilot_chaos"
+
+    def wait(predicate, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.005)
+        raise TimeoutError("autopilot chaos fleet condition not met")
+
+    def run(chaos: bool):
+        processes = []
+
+        def make_process():
+            process = Process(transport_kind="loopback")
+            processes.append(process)
+            return process
+
+        registrar_process = make_process()
+        registrar = Registrar(registrar_process, name="reg",
+                              search_timeout=0.2)
+        registrar_process.run(in_thread=True)
+        wait(lambda: registrar.state == "primary")
+        replica_process = make_process()
+        replica = create_pipeline(
+            replica_process,
+            _autopilot_definition("autopilot_replica", work_ms=1))
+        replica_process.run(in_thread=True)
+
+        def make_gateway():
+            process = make_process()
+            gateway = Gateway(
+                process, policy="max_inflight=32;queue=512",
+                router_seed=seed, journal=_CHAOS_JOURNAL, ha=group,
+                autopilot=_AUTOPILOT_POLICY, metrics_interval=60.0)
+            gateway.discover(name="autopilot_replica*")
+            process.run(in_thread=True)
+            return gateway
+
+        gateway_a = make_gateway()
+        wait(lambda: gateway_a.role == "primary")
+        gateway_b = make_gateway()
+        wait(lambda: gateway_b.election.state == "secondary")
+        for gateway in (gateway_a, gateway_b):
+            wait(lambda: len(gateway.replicas) == 1 and all(
+                handle.consumer.last_update is not None
+                for handle in gateway.replicas.values()))
+
+        client_process = make_process()
+        reply_topic = (f"{client_process.topic_path_process}/0/"
+                       f"autopilot_chaos")
+        lock = threading.Lock()
+        responses: dict = {}
+        primary = {"topic": gateway_a.topic_path}
+
+        def on_reply(topic, payload):
+            try:
+                command, parameters = parse(payload)
+            except ValueError:
+                return
+            if command != "process_frame_response" or not parameters:
+                return
+            reply = parameters[0]
+            if not isinstance(reply, dict) or reply.get("event"):
+                return
+            key = (str(reply.get("stream_id")),
+                   int(reply.get("frame_id", -1)))
+            outputs = (decode_frame_data(parameters[1])
+                       if len(parameters) > 1 else {})
+            with lock:
+                responses.setdefault(key, outputs.get("number"))
+
+        def on_boot(topic, payload):
+            try:
+                command, parameters = parse(payload)
+            except ValueError:
+                return
+            if (command == "primary" and parameters
+                    and parameters[0] == "found"
+                    and len(parameters) > 1):
+                primary["topic"] = str(parameters[1])
+
+        client_process.add_message_handler(on_reply, reply_topic)
+        client_process.add_message_handler(
+            on_boot, f"{client_process.namespace}/gateway/{group}")
+        client_process.run(in_thread=True)
+        stream_ids = [f"c{index}" for index in range(streams_n)]
+
+        def create(stream_id):
+            client_process.publish(
+                f"{primary['topic']}/in",
+                generate("create_stream", [
+                    stream_id, json.dumps({}).encode("ascii"), 600.0,
+                    reply_topic]))
+
+        def submit(stream_id, frame_id):
+            client_process.publish(
+                f"{primary['topic']}/in",
+                generate("process_frame", [
+                    {"stream_id": stream_id, "frame_id": frame_id},
+                    encode_frame_data(
+                        {"number": frame_id}).encode("ascii")]))
+
+        injector = create_injector(
+            f"seed={seed};process_kill:node=gateway_a:"
+            f"frame={kill_gateway}") if chaos else None
+        try:
+            for stream_id in stream_ids:
+                create(stream_id)
+            cursors = {stream_id: 0 for stream_id in stream_ids}
+            for index in range(total):
+                stream_id = stream_ids[index % streams_n]
+                frame_id = cursors[stream_id]
+                cursors[stream_id] += 1
+                submit(stream_id, frame_id)
+                if index in tick_frames:
+                    # one wire-harvest control-loop tick on whoever is
+                    # primary; the decide lands once every replica's
+                    # publish_trace reply arrives (or the wait lease
+                    # expires) -- deltas journal BEFORE they apply
+                    gateway_a.post_message("_autopilot_collect", [])
+                    time.sleep(1.0)
+                if injector is not None and injector.process_kill(
+                        "gateway_a"):
+                    gateway_a.process.crash()
+                time.sleep(0.004)
+            expected = {(stream_id, frame_id)
+                        for stream_id in stream_ids
+                        for frame_id in range(per_stream)}
+            deadline = time.monotonic() + (60 if SMOKE else 120)
+            while time.monotonic() < deadline:
+                with lock:
+                    missing = expected - set(responses)
+                if not missing:
+                    break
+                for stream_id in {key[0] for key in missing}:
+                    create(stream_id)
+                for stream_id, frame_id in sorted(missing):
+                    submit(stream_id, frame_id)
+                time.sleep(0.4)
+            with lock:
+                got = dict(responses)
+            primary_pilot = gateway_a.autopilot
+            standby_pilot = gateway_b.autopilot
+            applied_seqs = [record["seq"]
+                            for tick in primary_pilot.ledger
+                            for record in tick.get("applied", [])]
+            journaled = (gateway_b.journal.replay_deltas()
+                         if gateway_b.journal is not None else [])
+
+            def pilot_count(pilot, name):
+                counter = pilot.registry._counters.get(name)
+                return counter.value if counter is not None else 0
+
+            return {
+                "outputs": got,
+                "frames_lost": len(expected) - len(got),
+                "deltas_applied_primary": len(applied_seqs),
+                "deltas_journaled": len(journaled),
+                "deltas_adopted_standby": pilot_count(
+                    standby_pilot, "autopilot.deltas_adopted"),
+                "deltas_applied_standby": pilot_count(
+                    standby_pilot, "autopilot.deltas_applied"),
+                "config_restored": (
+                    standby_pilot._applied == primary_pilot._applied
+                    if chaos else None),
+                "takeover_ms": (gateway_b.telemetry.last_takeover_ms
+                                if chaos else None),
+            }
+        finally:
+            for process in processes:
+                try:
+                    process.terminate()
+                except Exception:
+                    pass
+
+    reference = run(chaos=False)
+    reset_brokers()
+    chaotic = run(chaos=True)
+    reset_brokers()
+    return {
+        "seed": seed,
+        "frames_total": total,
+        "bit_identical_to_uncrashed": (
+            chaotic["outputs"] == reference["outputs"]),
+        "frames_lost": chaotic["frames_lost"],
+        "frames_lost_reference": reference["frames_lost"],
+        "deltas_applied_primary": chaotic["deltas_applied_primary"],
+        "deltas_journaled": chaotic["deltas_journaled"],
+        "deltas_adopted_standby": chaotic["deltas_adopted_standby"],
+        "deltas_applied_standby": chaotic["deltas_applied_standby"],
+        "config_restored": chaotic["config_restored"],
+        "takeover_ms": chaotic["takeover_ms"],
+        "topology": ("registrar + 1 wire-discovered replica + HA "
+                     "gateway pair with retained delta journal, "
+                     "loopback broker"),
+    }
+
+
+def bench_autopilot(peak, seed: int | None = None):
+    """`autopilot` config: the online SLO control loop end to end.
+    Arm 1 starts a deliberately mis-tuned fleet (micro_batch=16 for a
+    closed-loop window of 2) and drives deterministic tick_now() loops:
+    live trace harvest -> tune -> clamped deltas through the no-restart
+    setter paths, converging to within `margin` of the hand-tuned
+    reference with zero replica recompiles; the per-tick delta ledger
+    is published.  Arm 2 kills the HA gateway primary in the apply
+    window under seeded chaos: the standby adopts the write-ahead delta
+    journal (every applied delta accounted, none re-applied) and the
+    run stays bit-identical to an unkilled reference with
+    frames_lost == 0.  Host-side (loopback broker): the numbers are
+    control-loop quality bounds, not throughput figures."""
+    seed = int(os.environ.get("AIKO_CHAOS_SEED", "11")
+               if seed is None else seed)
+    result = _autopilot_convergence_arm()
+    result["policy"] = _AUTOPILOT_POLICY
+    result["chaos"] = _autopilot_chaos_arm(seed)
+    timeline_path = os.environ.get("AIKO_AUTOPILOT_TIMELINE")
+    if timeline_path:
+        try:
+            with open(timeline_path, "w") as handle:
+                json.dump(result, handle, indent=2)
+            result["timeline_file"] = timeline_path
+        except OSError as error:
+            result["timeline_error"] = str(error)
+    return result
+
+
 # -- config 6b: continuous batching (decode/ engine) -------------------------
 
 def bench_continuous(peak):
@@ -3707,6 +4136,7 @@ def collect_definitions() -> dict:
              "autoscale_policy": _AUTOSCALE_POLICY},
             {"preset": det_preset, "micro_batch": serving_micro,
              "dtype": "float32" if SMOKE else "bfloat16"}),
+        "autopilot": _autopilot_definition("bench_autopilot"),
         "chaos": _chaos_definition("bench_chaos"),
         "chaos_decode": _chaos_decode_definition("bench_chaos_decode"),
         "prefix_cache": _prefix_cache_definition("bench_prefix_cache"),
@@ -3743,6 +4173,8 @@ _SUMMARY_FIELDS = (
     ("latency", "p50_ms", "latency_p50_ms"),
     ("autoscale", "time_to_healthy_warm_ms", "tth_warm_ms"),
     ("autoscale", "warm_vs_cold_speedup", "warm_speedup"),
+    ("autopilot", "converged", "ap_converged"),
+    ("autopilot", "deltas_applied", "ap_deltas"),
     ("chaos", "frames_lost", "chaos_lost"),
     ("chaos", "takeover_ms", "takeover_ms"),
     ("scale", "streams", "scale_streams"),
@@ -3850,8 +4282,8 @@ def main() -> None:
     peak = _peak_flops_per_chip()
     default_configs = ("text,asr,detector,llm,llm_sharded,train,"
                        "longcontext,serving,continuous,chunked_prefill,"
-                       "spec_decode,prefix_cache,disagg,autoscale,chaos,"
-                       "latency,scale,tts,pipeline")
+                       "spec_decode,prefix_cache,disagg,autoscale,"
+                       "autopilot,chaos,latency,scale,tts,pipeline")
     wanted = os.environ.get("AIKO_BENCH_CONFIGS",
                             default_configs).split(",")
     configs = {}
@@ -3886,6 +4318,8 @@ def main() -> None:
             bench_router, peak, router_replicas or 2)
     if "autoscale" in wanted:
         configs["autoscale"] = _with_control_plane(bench_autoscale, peak)
+    if "autopilot" in wanted:
+        configs["autopilot"] = _with_control_plane(bench_autopilot, peak)
     if "chaos" in wanted:
         configs["chaos"] = _with_control_plane(bench_chaos, peak)
     if "latency" in wanted:
