@@ -46,6 +46,33 @@
 #                    adopt_timeout on the KV-migration paths -- must
 #                    bound the caller and degrade to re-prefill
 #
+# WAN-shaped points (the region fault plane, riding the same seeded
+# broker machinery -- exercised by the federated chaos arms and
+# tests/test_region.py).  Links are DIRECTED (region, region) pairs,
+# written src=us:dst=eu (or node=us>eu); these points default to
+# times=-1 because a link's latency/loss is a property of the link,
+# not a one-shot event:
+#
+#   link_latency     every cross-region delivery over the (src, dst)
+#                    link is delayed ms= milliseconds (consulted by
+#                    the loopback broker at fan-out when publisher and
+#                    subscriber carry different `chaos_region`s)
+#   link_jitter      adds a DETERMINISTIC extra 0..ms= delay per
+#                    delivery, hashed from (seed, link, subscriber,
+#                    publish ordinal) -- WAN variance without losing
+#                    bit-reproducibility
+#   link_loss        cross-region deliveries over the link are dropped
+#                    (rate= for lossy links, frame=k for targeted
+#                    drops); loss is delivery-side, so an intra-region
+#                    subscriber still hears the publish
+#   region_partition a whole REGION is severed at once: every client
+#                    whose `chaos_region` matches node= partitions
+#                    from the broker (both directions, LWT fires) at
+#                    its frame=k-th publish, each client consuming its
+#                    OWN publish ordinal so one spec severs every
+#                    group in the region deterministically; ms=
+#                    schedules the heal exactly like broker_partition
+#
 # Determinism contract: rate-based selection hashes (seed, point, node,
 # frame_id) -- the SAME frames are poisoned on every run with the same
 # seed, independent of call order, thread timing, or how many other
@@ -60,9 +87,13 @@
 #   point     := element_raise | fetch_drop | reply_blackhole
 #              | dispatch_delay | connection_drop | replica_kill
 #              | process_kill | broker_partition | registrar_kill
+#              | link_latency | link_loss | link_jitter
+#              | region_partition
 #   keys      := node=<name> frame=<int> rate=<float 0..1>
 #                times=<int, -1 = unlimited> ms=<float>
 #                once=<1: each selected frame fails at most once>
+#                src=<region> dst=<region>   (link_* points only: the
+#                directed link; equivalent to node=<src>><dst>)
 #
 # Examples:
 #   "seed=7;element_raise:node=asr:frame=3:times=1"   transient: frame 3
@@ -89,12 +120,20 @@ import threading
 from .analyze.grammar import DirectiveGrammar, Field
 
 __all__ = ["FaultInjector", "FAULTS_GRAMMAR", "create_injector",
-           "get_injector", "reset_injector"]
+           "get_injector", "link_name", "reset_injector"]
 
 _POINTS = ("element_raise", "fetch_drop", "reply_blackhole",
            "dispatch_delay", "connection_drop", "replica_kill",
            "process_kill", "broker_partition", "registrar_kill",
-           "transfer_stall")
+           "transfer_stall", "link_latency", "link_loss",
+           "link_jitter", "region_partition")
+
+# WAN points describe standing conditions (a link HAS latency, a
+# severed region STAYS severed for every member), so their rules
+# default to times=-1 instead of the one-shot default.
+_CONTINUOUS_POINTS = frozenset(
+    ("link_latency", "link_loss", "link_jitter", "region_partition"))
+_LINK_POINTS = frozenset(("link_latency", "link_loss", "link_jitter"))
 
 # The spec grammar above as a declarative table over the shared
 # directive-grammar core (analyze/grammar.py): parse and offline lint
@@ -108,11 +147,22 @@ _RULE_FIELDS = {
     "ms": Field("float", minimum=0.0),
     "once": Field("flag"),
 }
+_LINK_FIELDS = dict(_RULE_FIELDS,
+                    src=Field("str"), dst=Field("str"))
 FAULTS_GRAMMAR = DirectiveGrammar(
     "faults",
     options={"seed": Field("int")},
-    heads={point: _RULE_FIELDS for point in _POINTS},
+    heads={point: (_LINK_FIELDS if point in _LINK_POINTS
+                   else _RULE_FIELDS)
+           for point in _POINTS},
     unknown_head_message="unknown fault point")
+
+
+def link_name(src, dst) -> str:
+    """The canonical node name for a directed (src, dst) region link:
+    `us>eu`.  Specs may write src=us:dst=eu or node=us>eu -- both
+    normalize here so selection state is shared."""
+    return f"{src}>{dst}"
 
 
 class _Rule:
@@ -121,11 +171,22 @@ class _Rule:
     __slots__ = ("node", "frame", "rate", "times", "ms", "once",
                  "fired", "seen", "calls")
 
-    def __init__(self, args: dict):
+    def __init__(self, args: dict, continuous: bool = False):
         self.node = args.get("node")
+        src, dst = args.get("src"), args.get("dst")
+        if (src is None) != (dst is None):
+            raise ValueError(
+                "faults: link points need BOTH src= and dst= "
+                "(the directed region link), or node=<src>><dst>")
+        if src is not None:
+            if self.node is not None:
+                raise ValueError(
+                    "faults: give node= OR src=/dst=, not both")
+            self.node = link_name(str(src).strip(), str(dst).strip())
         self.frame = (int(args["frame"]) if "frame" in args else None)
         self.rate = (float(args["rate"]) if "rate" in args else None)
-        self.times = int(args.get("times", 1 if self.rate is None else -1))
+        default_times = -1 if (continuous or self.rate is not None) else 1
+        self.times = int(args.get("times", default_times))
         self.ms = float(args.get("ms", 0.0))
         # once=1: each selected (node, frame) fires at most ONCE -- the
         # transient-fault shape (a retry of the same frame succeeds),
@@ -304,6 +365,51 @@ class FaultInjector:
             return 0.0
         return rule.ms / 1000.0 if rule.ms > 0 else -1.0
 
+    # -- WAN-shaped points (the region fault plane) --------------------
+
+    def link_drop(self, src, dst, frame_id=None, scope="") -> bool:
+        """Consume: drop THIS cross-region delivery over the directed
+        (src, dst) link?  The broker consults at fan-out, passing the
+        publisher's publish ordinal as `frame_id` and the subscriber's
+        name as `scope`, so rate= draws are a pure function of (seed,
+        link, subscriber, publish ordinal) -- identical firing
+        sequences on every run regardless of dispatch-thread
+        interleaving."""
+        return self._fire("link_loss", link_name(src, dst), frame_id,
+                          scope) is not None
+
+    def link_delay(self, src, dst, frame_id=None, scope="") -> float:
+        """Consume: extra delivery latency in SECONDS over the (src,
+        dst) link -- link_latency's fixed ms= plus link_jitter's
+        deterministic 0..ms= fraction, hashed from (seed, link, scope,
+        frame_id) so WAN variance stays bit-reproducible."""
+        link = link_name(src, dst)
+        delay_ms = 0.0
+        rule = self._fire("link_latency", link, frame_id, scope)
+        if rule is not None:
+            delay_ms += rule.ms
+        jitter = self._fire("link_jitter", link, frame_id, scope)
+        if jitter is not None and jitter.ms > 0:
+            key = (f"{self.seed}:link_jitter:{link}:{scope}:"
+                   f"{frame_id}").encode()
+            digest = hashlib.blake2b(key, digest_size=8).digest()
+            frac = int.from_bytes(digest, "big") / float(1 << 64)
+            delay_ms += jitter.ms * frac
+        return delay_ms / 1000.0
+
+    def region_partition(self, region, frame_id=None, scope="") -> float:
+        """Consume: sever this client's whole REGION from the broker?
+        Same return contract as broker_partition (seconds; -1.0 =
+        until heal).  Each client in the region consults with its OWN
+        publish ordinal as `frame_id` and its name as `scope`, so one
+        `region_partition:node=eu:frame=0` spec severs EVERY eu client
+        at its first publish -- the region dies as a unit, and the
+        firing sequence is identical on every run."""
+        rule = self._fire("region_partition", region, frame_id, scope)
+        if rule is None:
+            return 0.0
+        return rule.ms / 1000.0 if rule.ms > 0 else -1.0
+
     def registrar_kill(self, registrar) -> bool:
         """Consume: should the registrar `registrar` die now?  Same
         shape as process_kill; a separate point so one chaos spec can
@@ -326,7 +432,8 @@ def create_injector(spec) -> FaultInjector | None:
     seed = int(parsed.options.get("seed", 0))
     rules: dict[str, list[_Rule]] = {}
     for head, args in parsed.directives:
-        rules.setdefault(head, []).append(_Rule(args))
+        rules.setdefault(head, []).append(
+            _Rule(args, continuous=head in _CONTINUOUS_POINTS))
     return FaultInjector(spec, seed=seed, rules=rules)
 
 

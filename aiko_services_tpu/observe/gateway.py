@@ -93,8 +93,23 @@ class GatewayTelemetry:
         self.affinity_misses = registry.counter(
             "gateway.affinity_misses")
         # warm KV failover (decode/checkpoint.py): migrated streams
-        # whose replay was deferred by the recovery_rate pacing window
+        # whose replay was deferred by the recovery_rate pacing window,
+        # plus the LIVE count of cohorts still parked (decremented when
+        # a cohort replays, its stream dies, or its stream is destroyed
+        # -- the leak the destroy-while-paced regression test watches)
         self.recovery_paced = registry.counter("gateway.recovery_paced")
+        self.recovery_paced_pending = registry.gauge(
+            "gateway.recovery_paced_pending")
+        # region-aware federation (serve/federation.py): streams
+        # adopted from a LOST group's journal onto this survivor, and
+        # the region-affinity outcome of every region-declaring stream
+        # admission (did placement land in the client's region?)
+        self.region_migrations = registry.counter(
+            "gateway.region_migrations")
+        self.region_affinity_hits = registry.counter(
+            "gateway.region_affinity_hits")
+        self.region_affinity_misses = registry.counter(
+            "gateway.region_affinity_misses")
         self.time_to_healthy = registry.histogram(
             "gateway.time_to_healthy_ms")
         self.warm_spawns = registry.counter("gateway.spawns_warm")
@@ -249,18 +264,19 @@ class GatewayTelemetry:
 
     # -- per-priority SLO attainment ---------------------------------------
 
-    def record_slo(self, priority: int, within: bool) -> None:
+    def record_slo(self, priority: int, within: bool,
+                   tenant: str | None = None) -> None:
         """One completed frame of an SLO-carrying stream judged against
         its declared slo_ms: per-priority-bucket attainment/burn
-        counters (the numbers ROADMAP #4's per-tenant accounting
-        reads)."""
+        counters, plus a parallel per-TENANT family (`:t:{tenant}`)
+        when the stream declared one -- the per-tenant accounting
+        surface the multi-tenant isolation test reads."""
         if not self.enabled:
             return
-        if within:
-            self.registry.counter(f"gateway.slo_ok:p{priority}").inc()
-        else:
-            self.registry.counter(
-                f"gateway.slo_miss:p{priority}").inc()
+        kind = "slo_ok" if within else "slo_miss"
+        self.registry.counter(f"gateway.{kind}:p{priority}").inc()
+        if tenant:
+            self.registry.counter(f"gateway.{kind}:t:{tenant}").inc()
 
     def configure_slo_window(self, window_s: float) -> None:
         """Re-window the burn accounting (the autopilot aligns it with
@@ -396,6 +412,14 @@ class GatewayTelemetry:
         if self.affinity_hits.value or self.affinity_misses.value:
             summary["affinity_hits"] = self.affinity_hits.value
             summary["affinity_misses"] = self.affinity_misses.value
+        if self.region_migrations.value:
+            summary["region_migrations"] = self.region_migrations.value
+        if (self.region_affinity_hits.value
+                or self.region_affinity_misses.value):
+            summary["region_affinity_hits"] = (
+                self.region_affinity_hits.value)
+            summary["region_affinity_misses"] = (
+                self.region_affinity_misses.value)
         slo = self.slo_summary()
         if slo:
             # per-priority SLO attainment/burn (the per-tenant

@@ -204,7 +204,7 @@ class _GatewayStream:
                  "topic_response", "throttle", "inflight", "delivered",
                  "delivered_floor", "cursor", "parked", "throttled",
                  "lease", "prefill_created", "keeper", "traces",
-                 "dispatch_s")
+                 "dispatch_s", "restore_hint", "tenant")
 
     def __init__(self, stream_id: str, priority: int, slo_ms: float,
                  parameters: dict, grace_time: float, replica: _Replica,
@@ -238,6 +238,17 @@ class _GatewayStream:
         # the gateway policy's keeper, or the journaled one after a
         # takeover -- "checkpoint locations ride the gateway journal"
         self.keeper: str | None = None
+        # one-shot warm-restore hint for ADOPTED streams (cross-group
+        # journal adoption rebuilds a stream with EMPTY inflight, so
+        # _migrate_streams has no frame to attach the restore hint to):
+        # the next dispatched frame carries it, then it clears --
+        # the adopting decode replica restores the checkpointed KV and
+        # re-decodes only the post-snapshot tail instead of
+        # cold re-prefilling
+        self.restore_hint: dict | None = None
+        # multi-tenant admission: the tenant this stream declared (""
+        # = untenanted), driving per-tenant buckets and SLO counters
+        self.tenant: str = ""
         # fleet tracing (telemetry-gated; both stay empty with
         # telemetry off): the gateway-owned ROOT trace per in-flight
         # frame, and each frame's first-dispatch perf_counter stamp
@@ -382,6 +393,17 @@ class Gateway(Actor):
         # failover time so the paced wave keeps _restore_hint's
         # drain/prefill-pool guards
         self._paced_frames: dict[str, dict] = {}
+        # region-aware degradation (serve/federation.py): federation
+        # groups known DEAD (a severed region, a lost HA pair).
+        # Placement audit and journal adoption both consult this set,
+        # so a lost region's streams remap onto the survivors (each
+        # survivor adopting exactly its rendezvous share) while every
+        # other stream keeps its pin
+        self._lost_groups: set[str] = set()
+        # lost group -> its (foreign) journal mirror, warmed at
+        # note_group_lost so the retained backend has replayed by
+        # adoption time
+        self._foreign_journals: dict = {}
         self.replicas: dict[str, _Replica] = {}
         self.streams: dict[str, _GatewayStream] = {}
         # parked frames: (priority, seq, stream_id, frame_id), dispatched
@@ -424,6 +446,11 @@ class Gateway(Actor):
         self.role = "single"
         self._journal_dirty: set[str] = set()
         self._journal_forgotten: set[str] = set()
+        # ids THIS incarnation has journaled whose forget has not yet
+        # flushed: self-adoption must never treat them as crash
+        # orphans (under churn, the replay_timeout recovery can race
+        # the forget flush and resurrect just-destroyed streams)
+        self._journal_session: set[str] = set()
         self._buckets_dirty = False
         self._journal_timer = None
         self._takeover_started: float | None = None
@@ -611,6 +638,7 @@ class Gateway(Actor):
         if self.journal is None or self.role == "standby":
             return
         self._journal_dirty.add(stream.stream_id)
+        self._journal_session.add(stream.stream_id)
         if self.journal_policy.interval_s <= 0:
             self._journal_tick()
 
@@ -641,6 +669,10 @@ class Gateway(Actor):
         self._journal_forgotten = set()
         self._buckets_dirty = False
         written = self.journal.write(records, forgotten, buckets)
+        # flushed forgets are really gone from the backend -- their ids
+        # can no longer be mistaken for crash orphans, so the session
+        # set stays bounded by live + pending-forget streams
+        self._journal_session.difference_update(forgotten)
         if written:
             self.telemetry.journal_appends.inc(written)
         self.telemetry.journal_entries.set(self.journal.entry_count())
@@ -719,6 +751,15 @@ class Gateway(Actor):
         if self.journal is None:
             return 0
         records, buckets, dropped = self.journal.replay()
+        if self._journal_session:
+            # an entry THIS incarnation wrote is not a crash orphan:
+            # it is either a live stream (skipped below anyway) or a
+            # just-destroyed one whose forget has not flushed yet --
+            # adopting it would resurrect a deliberately torn-down
+            # stream
+            records = [record for record in records
+                       if str(record.get("stream_id", ""))
+                       not in self._journal_session]
         if dropped:
             self.telemetry.journal_dropped_stale.inc(dropped)
         if self.autopilot is not None:
@@ -745,6 +786,20 @@ class Gateway(Actor):
                 "_journal_recover_retry", [],
                 max(self.journal_policy.replay_timeout_s, 0.05))
             return 0
+        adopted = self._adopt_records(records)
+        self._adopt_buckets(buckets)
+        if adopted:
+            self.telemetry.journal_replayed.inc(adopted)
+            self._update_share()
+            self._journal_tick()
+        return adopted
+
+    def _adopt_records(self, records) -> int:
+        """The shared record-adoption core: rebuild each journaled
+        stream (cursor + dedupe floor restored), group them under
+        per-old-replica ghost pins, then run the zero-loss migration
+        path.  Used by _adopt_journal (own crash/takeover) and
+        _adopt_group_ready (a LOST federation group's streams)."""
         ghosts: dict[str, _Replica] = {}
         adopted = 0
         for record in records:
@@ -768,11 +823,13 @@ class Gateway(Actor):
                                               DEFAULT_GRACE_TIME))
             except (TypeError, ValueError):
                 grace_time = DEFAULT_GRACE_TIME
+            parameters = dict(record.get("parameters") or {})
             stream = _GatewayStream(
                 stream_id, parse_int(record.get("priority", 0), 0),
                 parse_float(record.get("slo_ms", 0.0), 0.0),
-                dict(record.get("parameters") or {}), grace_time, ghost,
+                parameters, grace_time, ghost,
                 topic_response=(record.get("topic_response") or None))
+            stream.tenant = str(parameters.get("tenant", "") or "")
             stream.cursor = parse_int(record.get("cursor", 0), 0)
             stream.delivered_floor = parse_int(
                 record.get("delivered_upto", -1), -1)
@@ -789,13 +846,128 @@ class Gateway(Actor):
             ghost.streams.add(stream_id)
             adopted += 1
             self._journal_dirty.add(stream_id)  # re-journal the new pin
-        self._adopt_buckets(buckets)
         for ghost in ghosts.values():
             self._migrate_streams(ghost)
-        if adopted:
-            self.telemetry.journal_replayed.inc(adopted)
+        return adopted
+
+    # -- region-aware degradation (cross-group adoption) -------------------
+
+    def note_group_lost(self, group) -> None:
+        """Another federation group is DEAD (its region severed, its
+        HA pair gone).  Mark it lost -- placement audit now routes its
+        streams here when the rendezvous says so -- and warm the lost
+        group's journal mirror so that, one replay_timeout later,
+        _adopt_group_ready can rebuild OUR share of its streams with
+        warm-restore hints.  Composes journal failover + warm
+        checkpoints + federation: the journal names each stream's
+        keeper, the keeper holds its KV snapshot, and the rendezvous
+        decides which survivor adopts it."""
+        group = str(group)
+        if (self.federation_group is None
+                or group == self.federation_group
+                or group in self._lost_groups):
+            return
+        if group not in self.federation.groups:
+            _LOGGER.warning("%s: note_group_lost(%s): unknown group",
+                            self.name, group)
+            return
+        self._lost_groups.add(group)
+        self.share["federation_lost"] = ",".join(sorted(self._lost_groups))
+        _LOGGER.warning("%s: federation group %s marked lost",
+                        self.name, group)
+        if self.journal_policy is None:
+            # no journal machinery: placement still remaps NEW streams,
+            # but the lost group's live streams cannot be adopted
             self._update_share()
-            self._journal_tick()
+            return
+        if group not in self._foreign_journals:
+            # constructing the retained-backend journal SUBSCRIBES to
+            # the lost group's journal root now, so its mirror has
+            # warmed by the time adoption fires (sqlite backends read
+            # the shared path directly and need no warm-up)
+            root = f"{self.process.namespace}/gateway/{group}/journal"
+            self._foreign_journals[group] = GatewayJournal(
+                self.journal_policy, self.process, root)
+        self.post_message_later(
+            "_adopt_group_ready", [group],
+            max(self.journal_policy.replay_timeout_s, 0.05))
+        self._update_share()
+
+    def note_group_healed(self, group) -> None:
+        """The lost group is back: stop treating it as dead for
+        placement.  Streams the survivors already adopted STAY adopted
+        (their records were purged from the healed group's journal at
+        adoption, so it cannot re-pin them); only un-adopted streams
+        and new admissions flow back."""
+        group = str(group)
+        if group not in self._lost_groups:
+            return
+        self._lost_groups.discard(group)
+        self.share["federation_lost"] = ",".join(sorted(self._lost_groups))
+        journal = self._foreign_journals.pop(group, None)
+        if journal is not None:
+            journal.stop()
+        _LOGGER.warning("%s: federation group %s healed",
+                        self.name, group)
+        self._update_share()
+
+    def adopt_group_now(self, group) -> int:
+        """Synchronous cross-group adoption (deterministic tests: the
+        caller drained the broker, so the foreign mirror is warm)."""
+        return self._adopt_group_ready(group)
+
+    def _adopt_group_ready(self, group) -> int:
+        """Mailbox continuation of note_group_lost: replay the lost
+        group's journal and adopt exactly OUR rendezvous share of its
+        live streams -- every survivor runs this same filter, so each
+        stream is adopted exactly once, by the group the region-aware
+        placement law names.  Adopted records are purged from the
+        foreign journal so a healed group cannot re-pin them."""
+        group = str(group)
+        if group not in self._lost_groups:
+            return 0                  # healed before adoption fired
+        journal = self._foreign_journals.get(group)
+        if journal is None or self.federation is None:
+            return 0
+        records, _buckets, dropped = journal.replay()
+        if dropped:
+            self.telemetry.journal_dropped_stale.inc(dropped)
+        mine = []
+        for record in records:
+            stream_id = str(record.get("stream_id", ""))
+            if not stream_id or stream_id in self.streams:
+                continue
+            parameters = record.get("parameters") or {}
+            region = (str(parameters["region"])
+                      if isinstance(parameters, dict)
+                      and parameters.get("region") is not None else None)
+            try:
+                owner = self.federation.owner_of(
+                    stream_id, region=region, lost=self._lost_groups)
+            except ValueError:
+                continue
+            if owner == self.federation_group:
+                mine.append(record)
+        if not mine:
+            return 0
+        if not any(not replica.dead
+                   for replica in self.replicas.values()):
+            # the pool is empty (the outage took our replicas too):
+            # retry like the cold-start path; record expiry bounds it
+            self.post_message_later(
+                "_adopt_group_ready", [group],
+                max(self.journal_policy.replay_timeout_s, 0.05))
+            return 0
+        adopted = self._adopt_records(mine)
+        if adopted:
+            self.telemetry.region_migrations.inc(adopted)
+            self._update_share()
+            self._journal_tick()     # the new pins ride OUR journal...
+            journal.write({}, [str(record.get("stream_id"))
+                               for record in mine])
+            _LOGGER.warning(
+                "%s: adopted %d stream(s) from lost group %s",
+                self.name, adopted, group)
         return adopted
 
     def _adopt_buckets(self, levels: dict) -> None:
@@ -1045,11 +1217,21 @@ class Gateway(Actor):
                                         | set(replay_ids))
                 pending["hint"] = hint
                 continue
+            if not replay_ids and hint is not None:
+                # nothing in flight to carry the hint (adopted-journal
+                # streams rebuild with EMPTY inflight): arm the
+                # one-shot stream hint instead, so the next dispatched
+                # frame -- the client's resubmission against the
+                # restored dedupe floor -- warm-restores on the new
+                # replica (see _send_frame)
+                stream.restore_hint = hint
             migrated += 1
             if rate > 0 and migrated > immediate and replay_ids:
                 self._paced_frames[stream_id] = {"ids": replay_ids,
                                                  "hint": hint}
                 self.telemetry.recovery_paced.inc()
+                self.telemetry.recovery_paced_pending.set(
+                    len(self._paced_frames))
                 paced_streams += 1
                 paced_frames += len(replay_ids)
                 self.post_message_later(
@@ -1126,6 +1308,8 @@ class Gateway(Actor):
         was frozen by _restore_hint at failover time, so its
         drain/prefill-pool guards still hold."""
         pending = self._paced_frames.pop(str(stream_id), None)
+        self.telemetry.recovery_paced_pending.set(
+            len(self._paced_frames))
         stream = self.streams.get(str(stream_id))
         if not pending or not pending["ids"] or stream is None:
             return
@@ -1250,22 +1434,50 @@ class Gateway(Actor):
             self._reject_stream(stream_id, "duplicate_stream_id",
                                 topic_response, queue_response)
             return
-        if (self.federation_group is not None
-                and self.federation.owner_of(stream_id)
-                != self.federation_group):
-            # federated tier: the stream hashes to ANOTHER group --
-            # shed before the token bucket (a misrouted client must
-            # not burn this group's admission budget)
-            self._reject_stream(stream_id, "wrong_group",
-                                topic_response, queue_response)
-            return
+        region = (str(parameters["region"])
+                  if parameters.get("region") is not None else None)
+        if self.federation_group is not None:
+            # federated tier: region-aware placement audit (client
+            # region affinity first, rendezvous over the SURVIVING
+            # groups as fallback) -- a stream that hashes to ANOTHER
+            # live group sheds wrong_group before the token bucket (a
+            # misrouted client must not burn this group's admission
+            # budget)
+            if (self.federation.owner_of(stream_id, region=region,
+                                         lost=self._lost_groups)
+                    != self.federation_group):
+                self._reject_stream(stream_id, "wrong_group",
+                                    topic_response, queue_response)
+                return
+            if region is not None:
+                # degradation evidence: did the declared region
+                # affinity land in-region, or did a region loss push
+                # the stream cross-region?
+                if self.federation.region_of(
+                        self.federation_group) == region:
+                    self.telemetry.region_affinity_hits.inc()
+                else:
+                    self.telemetry.region_affinity_misses.inc()
         now = time.monotonic()
+        tenant = str(parameters.get("tenant", "") or "")
         bucket = self.policy.bucket_for(priority)
         if bucket is not None:
             taken = bucket.try_take(now)
             self._buckets_dirty = self.journal is not None
             if not taken:
                 self._reject_stream(stream_id, "rate_limited",
+                                    topic_response, queue_response)
+                return
+        tenant_bucket = self.policy.tenant_bucket_for(tenant)
+        if tenant_bucket is not None:
+            # multi-tenant isolation: each tenant burns its OWN budget
+            # -- one tenant's storm exhausts its bucket and sheds
+            # rate_limited_tenant, with zero draw on any other
+            # tenant's tokens (the isolation proof rides this)
+            taken = tenant_bucket.try_take(now)
+            self._buckets_dirty = self.journal is not None
+            if not taken:
+                self._reject_stream(stream_id, "rate_limited_tenant",
                                     topic_response, queue_response)
                 return
         # prefix-affinity: the client's chain-head digest (computed
@@ -1303,6 +1515,7 @@ class Gateway(Actor):
             stream_id, priority, slo_ms, parameters, grace_time, replica,
             queue_response=queue_response, topic_response=topic_response,
             throttle=throttle)
+        stream.tenant = tenant
         if self.checkpoint is not None and self.checkpoint.keeper:
             stream.keeper = self.checkpoint.keeper
         stream.lease = Lease(
@@ -1433,10 +1646,15 @@ class Gateway(Actor):
         parked_ids = {item[3] for item in self._parked
                       if item[2] == stream_id}
         # paced failover replays that never fired behave like parked
-        # entries: in inflight, but no replica slot was ever taken
+        # entries: in inflight, but no replica slot was ever taken.
+        # Dropping the cohort entry here is what keeps the later
+        # scheduled _paced_replay a no-op (its pop finds nothing) --
+        # a destroyed stream must never leak a replay dispatch
         paced = self._paced_frames.pop(stream_id, None)
         if paced is not None:
             parked_ids |= set(paced["ids"])
+            self.telemetry.recovery_paced_pending.set(
+                len(self._paced_frames))
         if stream.parked:
             self._parked = [item for item in self._parked
                             if item[2] != stream_id]
@@ -1533,6 +1751,22 @@ class Gateway(Actor):
             self.post_message("_replica_lost", [
                 replica.topic_path, "injected replica_kill"])
             return
+        if (stream.restore_hint is not None and data is None
+                and replica.pool_role() != "prefill"):
+            # one-shot warm-restore for an ADOPTED stream: its journal
+            # rebuild had no inflight frames to replay, so the FIRST
+            # frame dispatched after adoption (the client's
+            # resubmission) carries the restore hint -- the decode
+            # replica adopts the checkpointed KV and re-decodes only
+            # the post-snapshot tail instead of cold re-prefilling
+            data = dict(entry[0])
+            restore = dict(stream.restore_hint)
+            adopt_trace = stream.traces.get(frame_id)
+            if adopt_trace is not None:
+                restore["trace_context"] = make_trace_context(
+                    adopt_trace)
+            data["restore"] = restore
+            stream.restore_hint = None
         route_start = time.perf_counter()
         replica.outstanding += 1
         replica.routed += 1
@@ -1827,11 +2061,13 @@ class Gateway(Actor):
             self.telemetry.completed.inc()
             self.telemetry.latency.record(now - entry[1])
             if stream.slo_ms > 0:
-                # per-priority SLO attainment: completed frames judged
-                # against the stream's declared end-to-end budget
+                # per-priority (and per-tenant) SLO attainment:
+                # completed frames judged against the stream's
+                # declared end-to-end budget
                 self.telemetry.record_slo(
                     stream.priority,
-                    (now - entry[1]) * 1000.0 <= stream.slo_ms)
+                    (now - entry[1]) * 1000.0 <= stream.slo_ms,
+                    tenant=stream.tenant or None)
             self._completions.append(now)
             if len(self._completions) > _RATE_WINDOW:
                 del self._completions[:len(self._completions)
@@ -1966,7 +2202,9 @@ class Gateway(Actor):
         stream.dispatch_s.clear()
         self.telemetry.forget_stream(stream.stream_id)
         stream.inflight.clear()
-        self._paced_frames.pop(stream.stream_id, None)
+        if self._paced_frames.pop(stream.stream_id, None) is not None:
+            self.telemetry.recovery_paced_pending.set(
+                len(self._paced_frames))
         if stream.parked:
             self._parked = [item for item in self._parked
                             if item[2] != stream.stream_id]
@@ -2124,6 +2362,9 @@ class Gateway(Actor):
         self.telemetry.stop()
         for stream_id in list(self.streams):
             self.destroy_stream(stream_id)
+        for journal in self._foreign_journals.values():
+            journal.stop()
+        self._foreign_journals.clear()
         if self.journal is not None:
             # a CLEAN stop clears the journal (every stream destroyed
             # above was forgotten): a later restart must not re-pin

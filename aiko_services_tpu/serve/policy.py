@@ -28,8 +28,18 @@
 #                                        STREAM admission (priority 0 is
 #                                        most important; priorities
 #                                        without a bucket admit freely)
+#              | "bucket:tenant:" name "=" rate "/" burst
+#                                        per-TENANT token bucket --
+#                                        streams declaring parameter
+#                                        `tenant=<name>` draw from
+#                                        their tenant's bucket IN
+#                                        ADDITION to their priority
+#                                        bucket, so one tenant's storm
+#                                        exhausts its own tokens, never
+#                                        another tenant's admission
 #
 # Example: "max_inflight=8;queue=64;hysteresis=0.5;bucket:2=10/4"
+#          "bucket:tenant:gold=100/20;bucket:tenant:free=10/4"
 #
 # Validation is at parse time, like the pipeline-definition and fault
 # grammars: a typo'd policy must fail the gateway's construction, not
@@ -51,14 +61,23 @@ DEFAULT_THROTTLE_RATE = 5.0
 
 
 def _parse_bucket(tail, value):
-    """`bucket:P=rate/burst` -> (priority, rate, burst); dict-shaped
-    specs may carry (rate, burst) tuples."""
-    priority = int(tail)
+    """`bucket:P=rate/burst` -> (priority, rate, burst);
+    `bucket:tenant:NAME=rate/burst` -> (("tenant", name), rate, burst).
+    Dict-shaped specs may carry (rate, burst) tuples."""
+    tail = str(tail)
+    if tail.startswith("tenant:"):
+        tenant = tail[len("tenant:"):].strip()
+        if not tenant:
+            raise ValueError(
+                "bucket:tenant:<name>= needs a non-empty tenant name")
+        key = ("tenant", tenant)
+    else:
+        key = int(tail)
     if isinstance(value, (tuple, list)):
         rate, burst = value
     else:
         rate, _, burst = str(value).partition("/")
-    return priority, float(rate), float(burst or rate)
+    return key, float(rate), float(burst or rate)
 
 
 # The grammar above as a declarative table over the shared
@@ -113,7 +132,8 @@ class TokenBucket:
 class AdmissionPolicy:
     __slots__ = ("max_inflight", "queue_capacity", "hysteresis_s",
                  "stale_after_s", "throttle_high", "throttle_low",
-                 "throttle_rate", "frame_deadline_s", "buckets", "spec")
+                 "throttle_rate", "frame_deadline_s", "buckets",
+                 "tenant_buckets", "spec")
 
     def __init__(self):
         self.max_inflight = DEFAULT_MAX_INFLIGHT
@@ -125,6 +145,7 @@ class AdmissionPolicy:
         self.throttle_rate = DEFAULT_THROTTLE_RATE
         self.frame_deadline_s = 0.0
         self.buckets: dict[int, TokenBucket] = {}
+        self.tenant_buckets: dict[str, TokenBucket] = {}
         self.spec = ""
 
     @classmethod
@@ -160,8 +181,11 @@ class AdmissionPolicy:
             clamp = clamps.get(key)
             setattr(policy, attributes[key],
                     clamp(value) if clamp else value)
-        for _, _, (priority, rate, burst) in parsed.prefixed:
-            policy.buckets[priority] = TokenBucket(rate, burst)
+        for _, _, (key, rate, burst) in parsed.prefixed:
+            if isinstance(key, tuple):
+                policy.tenant_buckets[key[1]] = TokenBucket(rate, burst)
+            else:
+                policy.buckets[key] = TokenBucket(rate, burst)
         if policy.throttle_low > policy.throttle_high:
             raise ValueError(
                 f"throttle_low {policy.throttle_low} must not exceed "
@@ -171,8 +195,17 @@ class AdmissionPolicy:
     def bucket_for(self, priority: int) -> TokenBucket | None:
         return self.buckets.get(int(priority))
 
+    def tenant_bucket_for(self, tenant) -> TokenBucket | None:
+        """The per-tenant admission bucket, or None when the tenant is
+        unnamed or unbucketed (unbucketed tenants admit freely -- the
+        grammar only constrains tenants it names)."""
+        if not tenant:
+            return None
+        return self.tenant_buckets.get(str(tenant))
+
     def __repr__(self):
         return (f"AdmissionPolicy(max_inflight={self.max_inflight}, "
                 f"queue={self.queue_capacity}, "
                 f"hysteresis={self.hysteresis_s}, "
-                f"buckets={sorted(self.buckets)})")
+                f"buckets={sorted(self.buckets)}, "
+                f"tenants={sorted(self.tenant_buckets)})")

@@ -87,6 +87,10 @@ class LoopbackBroker:
         self._m_delivered = registry.counter("broker.fanout_delivered")
         self._m_avoided = registry.counter("broker.fanout_avoided")
         self._m_match = registry.histogram("broker.match_s")
+        # WAN fault plane (faults.py link_latency/link_loss/link_jitter):
+        # fired-injection evidence the chaos arms reconcile against
+        self._m_link_delays = registry.counter("faults.link_delays")
+        self._m_link_drops = registry.counter("faults.link_drops")
         self._queues = [queue.Queue() for _ in range(self._shards)]
         self._threads = [
             threading.Thread(
@@ -132,7 +136,13 @@ class LoopbackBroker:
 
     # -- pub/sub -----------------------------------------------------------
 
-    def publish(self, topic: str, payload, retain: bool = False) -> None:
+    def publish(self, topic: str, payload, retain: bool = False,
+                origin=None) -> None:
+        """`origin` is the WAN fault plane's provenance tag -- a
+        (region, publish ordinal, client name) triple a chaos-labeled
+        transport attaches so cross-region deliveries can consult the
+        seeded link_latency/link_loss/link_jitter points at fan-out.
+        None (every production publish) costs one is-None check."""
         payload = _to_text(payload)
         if retain:
             with self._lock:
@@ -140,7 +150,8 @@ class LoopbackBroker:
                     self._retained.pop(topic, None)  # MQTT clears on empty
                 else:
                     self._retained[topic] = payload
-        self._queues[self._shard_of(topic)].put(("publish", topic, payload))
+        self._queues[self._shard_of(topic)].put(
+            ("publish", topic, payload, origin))
 
     def deliver_retained(self, client: "LoopbackTransport",
                          pattern: str) -> None:
@@ -166,14 +177,50 @@ class LoopbackBroker:
             if item is None:
                 return
             if item[0] == "publish":
-                _, topic, payload = item
+                _, topic, payload, origin = item
                 matched = self._match_clients(topic)
                 for client in matched:
-                    if client._connected:
-                        client._deliver(topic, payload)
+                    if not client._connected:
+                        continue
+                    if origin is not None and not self._link_admits(
+                            origin, client):
+                        continue
+                    client._deliver(topic, payload)
             else:  # retained delivery to one client
                 _, topic, payload, client = item
                 client._deliver(topic, payload)
+
+    def _link_admits(self, origin, client) -> bool:
+        """WAN fault plane: should this delivery cross its region link
+        now, and after how long?  Consulted per (publish, subscriber)
+        pair only when the publisher carried an `origin` tag AND the
+        subscriber declares a different `chaos_region`; intra-region
+        (or unlabeled) deliveries never reach the injector.  The draw
+        keys on (link, subscriber, publish ordinal), so firing is
+        identical across runs regardless of shard-thread timing.  A
+        fired link_latency/link_jitter sleeps ON the dispatch shard --
+        deliveries over one topic's shard serialize behind the slow
+        link, which is exactly the convoy a congested WAN path
+        creates."""
+        src_region, publish_seq, _publisher = origin
+        dst_region = client.chaos_region
+        if dst_region is None or dst_region == src_region:
+            return True
+        from ..faults import get_injector
+        injector = get_injector()
+        if injector is None:
+            return True
+        scope = client.chaos_name or str(client.client_id)
+        if injector.link_drop(src_region, dst_region,
+                              frame_id=publish_seq, scope=scope):
+            self._m_link_drops.inc()
+            return False
+        delay = injector.link_delay(src_region, dst_region,
+                                    frame_id=publish_seq, scope=scope)
+        if delay > 0:
+            self._m_link_delays.inc()
+            time.sleep(delay)
+        return True
 
     def _match_clients(self, topic: str) -> list:
         """The clients this message must wake.  Trie-mode order is
@@ -254,6 +301,14 @@ class LoopbackTransport(Transport):
         # `broker_partition` fault point (faults.py).  None (the
         # default) costs one attribute check per publish
         self.chaos_name: str | None = None
+        # WAN fault plane: the region this client lives in.  None (the
+        # default) keeps every publish on the partition-only fast
+        # path; set, each publish carries an (region, ordinal, name)
+        # origin tag and consults the seeded `region_partition` point
+        # with this client's OWN publish ordinal -- so one spec severs
+        # every client in a region deterministically (faults.py)
+        self.chaos_region: str | None = None
+        self._publish_seq = 0
         self._partitioned = False
         self.partition_dropped = 0   # publishes lost to a partition
 
@@ -315,12 +370,20 @@ class LoopbackTransport(Transport):
             raise RuntimeError("LoopbackTransport not connected")
         if self.chaos_name is not None and not self._partitioned:
             self._consult_partition_point()
+        origin = None
+        if self.chaos_region is not None:
+            seq = self._publish_seq
+            self._publish_seq += 1
+            if not self._partitioned:
+                self._consult_region_point(seq)
+            origin = (self.chaos_region, seq,
+                      self.chaos_name or str(self.client_id))
         if self._partitioned:
             # a partitioned client's publishes die on the wire (QoS 0
             # semantics); the counter is the reconcile evidence
             self.partition_dropped += 1
             return
-        self._broker.publish(topic, payload, retain)
+        self._broker.publish(topic, payload, retain, origin=origin)
 
     def _consult_partition_point(self) -> None:
         """Seeded chaos: one `broker_partition` draw per publish
@@ -331,6 +394,26 @@ class LoopbackTransport(Transport):
         if injector is None:
             return
         duration = injector.broker_partition(self.chaos_name)
+        if duration == 0.0:
+            return
+        self.partition()
+        if duration > 0:
+            timer = threading.Timer(duration, self.heal)
+            timer.daemon = True
+            timer.start()
+
+    def _consult_region_point(self, seq: int) -> None:
+        """Seeded chaos: one `region_partition` draw per publish for a
+        region-labeled client (faults.py; node= the region, frame=k
+        severs at THIS client's k-th publish so the whole region dies
+        as a unit, ms= schedules the heal)."""
+        from ..faults import get_injector
+        injector = get_injector()
+        if injector is None:
+            return
+        duration = injector.region_partition(
+            self.chaos_region, frame_id=seq,
+            scope=self.chaos_name or str(self.client_id))
         if duration == 0.0:
             return
         self.partition()

@@ -2023,6 +2023,7 @@ def bench_chaos(peak, seed: int | None = None):
                      "HA gateway pair, loopback broker"),
     }
     result["decode_replica_kill"] = _chaos_decode_replica_kill(seed)
+    result["region_partition"] = _chaos_region_partition(seed)
     timeline_path = os.environ.get("AIKO_CHAOS_TIMELINE")
     if timeline_path:
         try:
@@ -2292,6 +2293,245 @@ def _chaos_decode_replica_kill(seed: int):
                      "standby keeper + paced gateway, loopback"),
     }
     return block
+
+
+def _chaos_region_partition(seed: int):
+    """Region loss under a continuous-batching storm: a two-region
+    federated tier (`groups=us:a,eu:c`, one checkpointed decode
+    replica per region, a SHARED CheckpointKeeper) loses the eu
+    region at a seeded `region_partition` point mid-storm.  The
+    surviving us gateway warms the lost group's journal mirror,
+    adopts exactly its rendezvous share of the eu streams
+    (region-aware owner_of over the survivors), and the client's
+    resubmitted frames carry the one-shot warm-restore hint -- the us
+    decode replica restores each adopted stream's checkpointed KV and
+    re-decodes only the post-snapshot tail instead of cold
+    re-prefilling.  Both arms (partition vs lossless) must be
+    BIT-IDENTICAL with frames_lost == 0 and reprefill_avoided_frac >
+    0: journal failover (round 13) x warm checkpoints (round 17) x
+    federation (round 19) composed into one robustness proof."""
+    import threading
+
+    from aiko_services_tpu.decode import CheckpointKeeper, reset_keepers
+    from aiko_services_tpu.faults import create_injector
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.pipeline.tensors import encode_frame_data
+    from aiko_services_tpu.runtime import Process
+    from aiko_services_tpu.serve import FederationRouter, Gateway
+    from aiko_services_tpu.transport import reset_brokers
+    from aiko_services_tpu.utils import generate, parse
+
+    import numpy as np
+
+    streams_n = 6 if SMOKE else 12
+    max_new = 24 if SMOKE else 48
+    prompt_len = 6
+    keeper_name = "bench_region_keeper"
+    federation_groups = "groups=us:a,eu:c"
+    rng = np.random.default_rng(seed + 1)
+    frames = [rng.integers(1, 300, size=(1, prompt_len))
+              .astype(np.int32) for _ in range(streams_n)]
+    # alternate regions so BOTH gateways carry streams and the
+    # partition remaps exactly the eu half
+    regions = {f"r{index}": ("us" if index % 2 == 0 else "eu")
+               for index in range(streams_n)}
+    eu_ids = sorted(sid for sid, region in regions.items()
+                    if region == "eu")
+
+    def wait(predicate, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.005)
+        raise TimeoutError("region_partition condition not met")
+
+    def run(partition: bool):
+        reset_keepers()
+        keeper = CheckpointKeeper(keeper_name)
+        processes = []
+
+        def make_process():
+            process = Process(transport_kind="loopback")
+            processes.append(process)
+            return process
+
+        replicas = {
+            "us": create_pipeline(
+                make_process(), _chaos_decode_definition(
+                    "rg_dec_us", max_new=max_new, slots=streams_n,
+                    keeper=keeper_name)),
+            "eu": create_pipeline(
+                make_process(), _chaos_decode_definition(
+                    "rg_dec_eu", max_new=max_new, slots=streams_n,
+                    keeper=keeper_name)),
+        }
+        gateways = {}
+        for group, region in (("a", "us"), ("c", "eu")):
+            gateways[group] = Gateway(
+                make_process(), name=group,
+                policy="max_inflight=32;queue=128",
+                router_seed=seed, metrics_interval=60.0,
+                journal=_CHAOS_JOURNAL,
+                federation=(f"{federation_groups};"
+                            f"group={region}:{group}"),
+                checkpoint=f"recovery_rate=4;keeper={keeper_name}")
+            gateways[group].attach_replica(replicas[region])
+        router = FederationRouter(gateways, policy=federation_groups)
+
+        client_process = make_process()
+        reply_topic = (f"{client_process.topic_path_process}/0/"
+                       f"region_client")
+        lock = threading.Lock()
+        outputs: dict = {}
+
+        def on_reply(topic, payload):
+            try:
+                command, parameters = parse(payload)
+            except ValueError:
+                return
+            if (command != "process_frame_response"
+                    or len(parameters) < 2):
+                return
+            reply = parameters[0]
+            if not isinstance(reply, dict) or reply.get("event"):
+                return
+            from aiko_services_tpu.pipeline.tensors import (
+                decode_frame_data)
+            generated = decode_frame_data(parameters[1]).get(
+                "generated")
+            with lock:
+                outputs.setdefault(
+                    str(reply.get("stream_id")),
+                    np.asarray(generated).tolist())
+
+        client_process.add_message_handler(on_reply, reply_topic)
+        for process in processes:
+            process.run(in_thread=True)
+
+        def create(stream_id):
+            group = router.group_for(stream_id,
+                                     region=regions[stream_id])
+            client_process.publish(
+                f"{gateways[group].topic_path}/in",
+                generate("create_stream", [
+                    stream_id,
+                    json.dumps({"region": regions[stream_id]})
+                    .encode("ascii"),
+                    600.0, reply_topic]))
+
+        def submit(stream_id):
+            group = router.group_for(stream_id,
+                                     region=regions[stream_id])
+            client_process.publish(
+                f"{gateways[group].topic_path}/in",
+                generate("process_frame", [
+                    {"stream_id": stream_id, "frame_id": 0},
+                    encode_frame_data(
+                        {"tokens": frames[int(stream_id[1:])]})
+                    .encode("ascii")]))
+
+        injector = create_injector(
+            f"seed={seed};region_partition:node=eu:frame=0"
+        ) if partition else None
+        partition_at = None
+        for stream_id in sorted(regions):
+            create(stream_id)
+            submit(stream_id)
+        if partition:
+            # mid-storm: every stream checkpointed, none finished,
+            # and the eu group's journal holds its streams' pins
+            wait(lambda: keeper.flush(timeout=0.1)
+                 and keeper.kept_count() >= streams_n)
+            wait(lambda: gateways["c"].journal.entry_count()
+                 >= len(eu_ids))
+            if injector.region_partition("eu", frame_id=0,
+                                         scope="bench") != 0.0:
+                partition_at = time.perf_counter()
+                # the WHOLE region goes dark at once: replica and
+                # gateway sever with no clean shutdown
+                replicas["eu"].process.crash()
+                gateways["c"].process.crash()
+                router.fail_group("c")
+            # adoption before resubmission: the us gateway must hold
+            # the eu streams (restore hints armed) before the client's
+            # replay lands, or a fresh create would cold-prefill
+            wait(lambda: gateways["a"].telemetry
+                 .region_migrations.value >= len(eu_ids),
+                 timeout=60 if SMOKE else 120)
+        deadline = time.monotonic() + (120 if SMOKE else 300)
+        while time.monotonic() < deadline:
+            with lock:
+                missing = sorted(set(regions) - set(outputs))
+            if not missing:
+                break
+            if partition_at is not None:
+                # client replay against the surviving region: the
+                # create is an idempotent re-assertion, the frame
+                # dedupes against the restored floor
+                for stream_id in missing:
+                    create(stream_id)
+                    submit(stream_id)
+            time.sleep(0.4)
+        with lock:
+            got = dict(outputs)
+        recovery_ms = None
+        if partition_at is not None:
+            recovery_ms = round(
+                (time.perf_counter() - partition_at) * 1000, 1)
+        survivor = replicas["us"].elements["lm"]
+        engine = survivor.engine_stats() or {}
+        summary = gateways["a"].telemetry.summary()
+        block = {
+            "outputs": got,
+            "frames_lost": streams_n - len(got),
+            "region_migrations": summary.get("region_migrations", 0),
+            "region_affinity_hits": summary.get(
+                "region_affinity_hits", 0),
+            "region_affinity_misses": summary.get(
+                "region_affinity_misses", 0),
+            "restores": engine.get("restores", 0),
+            "restore_fallbacks": engine.get("restore_fallbacks", 0),
+            "injected": injector.stats() if injector else {},
+            "recovery_ms": recovery_ms,
+        }
+        for process in processes:
+            try:
+                process.terminate()
+            except Exception:
+                pass
+        reset_keepers()
+        reset_brokers()
+        return block
+
+    reference = run(partition=False)
+    partitioned = run(partition=True)
+    restores = partitioned["restores"]
+    fallbacks = partitioned["restore_fallbacks"]
+    return {
+        "seed": seed,
+        "streams": streams_n,
+        "regions": {"us": streams_n - len(eu_ids),
+                    "eu": len(eu_ids)},
+        "frames_lost": partitioned["frames_lost"],
+        "frames_lost_reference": reference["frames_lost"],
+        "bit_identical": partitioned["outputs"] == reference["outputs"],
+        "region_migrations": partitioned["region_migrations"],
+        "region_affinity_hits": partitioned["region_affinity_hits"],
+        "region_affinity_misses": partitioned[
+            "region_affinity_misses"],
+        "restores": restores,
+        "restore_fallbacks": fallbacks,
+        # the headline: adopted streams resumed from the shared
+        # keeper's checkpoints instead of re-running prompt prefill
+        "reprefill_avoided_frac": round(
+            restores / max(restores + fallbacks, 1), 4),
+        "recovery_ms": partitioned["recovery_ms"],
+        "injected": partitioned["injected"],
+        "topology": ("two-region federated tier (us:a, eu:c), one "
+                     "checkpointed decode replica per region, shared "
+                     "keeper, journaled gateways, loopback"),
+    }
 
 
 # -- autopilot: the online SLO control loop (observe -> decide -> act) -------
@@ -4058,6 +4298,279 @@ def bench_scale(peak):
     }
 
 
+def bench_soak(peak):
+    """`soak` config: the federated `scale` topology held under
+    SUSTAINED stream-churn load (waves of create -> frames -> destroy)
+    with a drift ledger -- periodic invariant probes that catch the
+    slow leaks a 5-second window never sees.  Probes per wave, at
+    quiescence: RSS, open fds, paged-pool block conservation
+    (free + cached == capacity on the decode lane), journal size
+    after compaction (destroyed streams must leave ZERO entries), and
+    telemetry counter reconciliation (per-wave frame conservation,
+    admitted+shed == offered streams, share.delta_publishes <=
+    share.updates_coalesced).  End-of-window drift assertions: RSS
+    slope (mean of last third vs first third) and fd growth bounded.
+    `AIKO_SOAK_SECONDS` sets the window (CI runs a bounded slice; the
+    full window rides the slow lane); `AIKO_SOAK_LEDGER` names a JSON
+    artifact path for the full ledger.  Region-failover correctness
+    that only holds for 5-second windows is not robustness -- this
+    config is the proof it holds for the long haul."""
+    import threading
+
+    from aiko_services_tpu.decode import CheckpointKeeper, reset_keepers
+    from aiko_services_tpu.observe.metrics import get_registry
+    from aiko_services_tpu.pipeline import create_pipeline
+    from aiko_services_tpu.runtime import Process
+    from aiko_services_tpu.serve import FederationRouter, Gateway
+    from aiko_services_tpu.transport import reset_brokers
+
+    window_s = float(os.environ.get(
+        "AIKO_SOAK_SECONDS", "45" if SMOKE else "300"))
+    echo_streams = 80 if SMOKE else 200
+    frames_per_stream = 2
+    decode_streams = 3
+    keeper_name = "bench_soak_keeper"
+    groups = ("g0", "g1")
+    journal_spec = "backend=retained;interval=0.05;search_timeout=0.5"
+    policy = "max_inflight=2048;queue=1024"
+    registry = get_registry()
+    share_before = dict(registry.snapshot()["counters"])
+
+    reset_keepers()
+    keeper = CheckpointKeeper(keeper_name)
+    processes = []
+
+    def make_process():
+        process = Process(transport_kind="loopback")
+        processes.append(process)
+        return process
+
+    echo_replicas = [create_pipeline(
+        make_process(), _scale_definition(f"soak_replica{index}"))
+        for index in range(2)]
+    decode_replica = create_pipeline(
+        make_process(), _chaos_decode_definition(
+            "soak_decode", max_new=8, slots=decode_streams + 1,
+            keeper=keeper_name))
+    gateways = {}
+    for group in groups:
+        gateways[group] = Gateway(
+            make_process(), name=f"soak_{group}", policy=policy,
+            federation=f"groups={','.join(groups)};group={group}",
+            journal=journal_spec, metrics_interval=3600.0)
+        for replica in echo_replicas:
+            gateways[group].attach_replica(replica)
+    router = FederationRouter(gateways)
+    decode_gateway = Gateway(
+        make_process(), name="soak_dec", policy="max_inflight=8;queue=32",
+        metrics_interval=3600.0,
+        checkpoint=f"recovery_rate=4;keeper={keeper_name}")
+    decode_gateway.attach_replica(decode_replica)
+    for process in processes:
+        process.run(in_thread=True)
+
+    import numpy as np
+    rng = np.random.default_rng(7)
+    page_kb = os.sysconf("SC_PAGE_SIZE") // 1024
+
+    def rss_kb():
+        try:
+            with open("/proc/self/statm") as handle:
+                return int(handle.read().split()[1]) * page_kb
+        except (OSError, IndexError, ValueError):
+            return None
+
+    def open_fds():
+        try:
+            return len(os.listdir("/proc/self/fd"))
+        except OSError:
+            return None
+
+    def wait(predicate, timeout=60.0, what="soak condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.005)
+        raise TimeoutError(f"{what} not met within {timeout}s")
+
+    ledger: list = []
+    findings: list = []
+    streams_total = 0
+    frames_total = 0
+    wave = 0
+    start = time.perf_counter()
+    deadline = time.monotonic() + window_s
+    while time.monotonic() < deadline:
+        wave += 1
+        offered = echo_streams * frames_per_stream + decode_streams
+        responses = queue.Queue()
+        answered = {"ok": 0, "shed": 0, "error": 0}
+        # -- the churn wave: echo storm through the federated tier,
+        #    a few checkpointed decode streams on the side
+        echo_ids = [f"w{wave}s{index}" for index in range(echo_streams)]
+        for stream_id in echo_ids:
+            router.submit_stream(stream_id, queue_response=responses,
+                                 grace_time=600)
+        for frame_id in range(frames_per_stream):
+            for index, stream_id in enumerate(echo_ids):
+                router.submit_frame(stream_id, {"number": index},
+                                    frame_id=frame_id)
+        decode_ids = [f"w{wave}d{index}"
+                      for index in range(decode_streams)]
+        for stream_id in decode_ids:
+            decode_gateway.submit_stream(
+                stream_id, {}, queue_response=responses,
+                grace_time=600)
+            decode_gateway.submit_frame(
+                stream_id,
+                {"tokens": rng.integers(1, 300, size=(1, 6))
+                 .astype(np.int32)},
+                frame_id=0)
+        for _ in range(offered):
+            try:
+                _sid, _fid, _out, status = responses.get(timeout=120)
+            except queue.Empty:
+                break
+            answered[status if status in answered else "error"] += 1
+        streams_total += echo_streams + decode_streams
+        frames_total += offered
+        # -- drain to quiescence: destroy everything, then probe
+        for stream_id in echo_ids:
+            router.destroy_stream(stream_id)
+        for stream_id in decode_ids:
+            decode_gateway.post_message("destroy_stream", [stream_id])
+        try:
+            wait(lambda: not any(gateway.streams for gateway in
+                                 gateways.values())
+                 and not decode_gateway.streams,
+                 what=f"wave {wave} stream teardown")
+            wait(lambda: (decode_replica.elements["lm"]
+                          .engine_stats() or {}).get("active_slots",
+                                                     -1) == 0,
+                 what=f"wave {wave} decode slot release")
+            for gateway in gateways.values():
+                wait(lambda g=gateway: g.journal.entry_count() == 0
+                     or g.journal.compact() >= 0
+                     and g.journal.entry_count() == 0,
+                     timeout=15,
+                     what=f"wave {wave} journal drain")
+        except TimeoutError as error:
+            findings.append(str(error))
+        # -- the drift probes
+        delivered = answered["ok"] + answered["shed"] + answered["error"]
+        if delivered != offered:
+            findings.append(
+                f"wave {wave}: frame conservation broke -- "
+                f"{delivered}/{offered} answered")
+        admitted = sum(gateway.telemetry.admitted.value
+                       + gateway.telemetry.shed_streams.value
+                       for gateway in gateways.values())
+        admitted += (decode_gateway.telemetry.admitted.value
+                     + decode_gateway.telemetry.shed_streams.value)
+        if admitted != streams_total:
+            findings.append(
+                f"wave {wave}: admission reconciliation broke -- "
+                f"admitted+shed {admitted} != offered {streams_total}")
+        engine = decode_replica.elements["lm"].engine_stats() or {}
+        pool_free = engine.get("free_blocks", 0)
+        pool_cached = engine.get("prefix_cached_blocks", 0)
+        pool_capacity = engine.get("blocks", 0)
+        if pool_free + pool_cached != pool_capacity:
+            findings.append(
+                f"wave {wave}: paged-pool leak -- free {pool_free} + "
+                f"cached {pool_cached} != capacity {pool_capacity}")
+        journal_entries = sum(gateway.journal.entry_count()
+                              for gateway in gateways.values())
+        if journal_entries:
+            findings.append(
+                f"wave {wave}: journal kept {journal_entries} "
+                f"entr(ies) after compaction at quiescence")
+        counters = registry.snapshot()["counters"]
+
+        def share_delta(name):
+            return (counters.get(name, 0)
+                    - share_before.get(name, 0))
+
+        if (share_delta("share.delta_publishes")
+                > share_delta("share.updates_coalesced")):
+            findings.append(
+                f"wave {wave}: share coalescing inverted -- "
+                f"{share_delta('share.delta_publishes')} delta "
+                f"publishes from "
+                f"{share_delta('share.updates_coalesced')} staged "
+                f"updates")
+        ledger.append({
+            "wave": wave,
+            "t_s": round(time.perf_counter() - start, 2),
+            "rss_kb": rss_kb(),
+            "open_fds": open_fds(),
+            "pool_free": pool_free,
+            "pool_cached": pool_cached,
+            "pool_capacity": pool_capacity,
+            "journal_entries": journal_entries,
+            "answered": delivered,
+            "offered": offered,
+            "findings_total": len(findings),
+        })
+    elapsed = time.perf_counter() - start
+    # -- end-of-window drift assertions over the whole ledger
+    rss_series = [entry["rss_kb"] for entry in ledger
+                  if entry["rss_kb"] is not None]
+    if len(rss_series) >= 3:
+        third = max(len(rss_series) // 3, 1)
+        early = sum(rss_series[:third]) / third
+        late = sum(rss_series[-third:]) / third
+        budget_kb = max(32768.0, early * 0.10)
+        if late - early > budget_kb:
+            findings.append(
+                f"rss drift: {early:.0f} kB -> {late:.0f} kB "
+                f"(budget {budget_kb:.0f} kB over the window)")
+        rss_drift_kb = round(late - early, 1)
+    else:
+        rss_drift_kb = None
+    fd_series = [entry["open_fds"] for entry in ledger
+                 if entry["open_fds"] is not None]
+    if len(fd_series) >= 2 and fd_series[-1] > fd_series[0] + 16:
+        findings.append(
+            f"fd drift: {fd_series[0]} -> {fd_series[-1]} open fds")
+    for process in processes:
+        try:
+            process.terminate()
+        except Exception:
+            pass
+    reset_keepers()
+    reset_brokers()
+    ledger_path = os.environ.get("AIKO_SOAK_LEDGER")
+    if ledger_path:
+        try:
+            with open(ledger_path, "w") as handle:
+                json.dump({"findings": findings, "ledger": ledger},
+                          handle, indent=2)
+        except OSError as error:
+            findings.append(f"ledger write failed: {error}")
+    return {
+        "window_s": window_s,
+        "elapsed_s": round(elapsed, 1),
+        "waves": wave,
+        "streams_total": streams_total,
+        "frames_total": frames_total,
+        "drift_ok": not findings,
+        "findings": findings,
+        "rss_drift_kb": rss_drift_kb,
+        "open_fds_first": fd_series[0] if fd_series else None,
+        "open_fds_last": fd_series[-1] if fd_series else None,
+        "probes": len(ledger),
+        # the ledger rides the block (bounded); the full artifact goes
+        # to AIKO_SOAK_LEDGER for CI upload
+        "ledger": ledger[-40:],
+        "ledger_file": ledger_path,
+        "topology": (f"federated tier ({len(groups)} journaled "
+                     f"gateway groups, 2 echo replicas) + 1 "
+                     f"checkpointed decode lane, loopback"),
+    }
+
+
 def bench_tts(peak):
     """Text -> speech through the pipeline element (chars -> mel ->
     Griffin-Lim, ONE jit per frame batch): the last model family's
@@ -4177,6 +4690,8 @@ _SUMMARY_FIELDS = (
     ("autopilot", "deltas_applied", "ap_deltas"),
     ("chaos", "frames_lost", "chaos_lost"),
     ("chaos", "takeover_ms", "takeover_ms"),
+    ("soak", "drift_ok", "soak_drift_ok"),
+    ("soak", "waves", "soak_waves"),
     ("scale", "streams", "scale_streams"),
     ("scale", "goodput_fps", "scale_goodput"),
     ("scale", "frames_lost", "scale_lost"),
@@ -4326,6 +4841,8 @@ def main() -> None:
         configs["latency"] = _with_control_plane(bench_latency, peak)
     if "scale" in wanted:
         configs["scale"] = _with_control_plane(bench_scale, peak)
+    if "soak" in wanted:
+        configs["soak"] = _with_control_plane(bench_soak, peak)
     if "tts" in wanted:
         configs["tts"] = _with_control_plane(bench_tts, peak)
     headline_fps, headline_p50, audio_seconds = None, None, None

@@ -1,0 +1,687 @@
+# Region-aware graceful degradation (ISSUE 18): the WAN fault plane
+# (link_latency / link_loss / link_jitter / region_partition, seeded and
+# deterministic), region-labeled federation groups with region-aware
+# placement, cross-group adoption of a LOST group's journaled streams
+# (warm-restore hints armed for the client's resubmission), multi-tenant
+# admission isolation, the destroy-while-paced accounting fix, and the
+# soak-harness machinery behind `bench.py soak`.
+#
+# The acceptance invariant for the fault plane: two runs with the same
+# seed produce IDENTICAL fault firing sequences -- `faults.stats()`
+# equality is asserted directly.
+
+import json
+import queue
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from aiko_services_tpu import faults as faults_module
+from aiko_services_tpu.faults import create_injector, link_name
+from aiko_services_tpu.decode import CheckpointKeeper, reset_keepers
+from aiko_services_tpu.observe.metrics import get_registry
+from aiko_services_tpu.pipeline import (
+    PipelineElement, StreamEvent, create_pipeline)
+from aiko_services_tpu.runtime import Process
+from aiko_services_tpu.serve import (
+    FederationPolicy, FederationRouter, Gateway, assign_group)
+from aiko_services_tpu.serve.policy import AdmissionPolicy
+from aiko_services_tpu.transport import get_broker, reset_brokers
+from aiko_services_tpu.transport.loopback import LoopbackTransport
+from helpers import wait_for
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    monkeypatch.delenv("AIKO_FAULTS", raising=False)
+    reset_brokers()
+    reset_keepers()
+    faults_module.reset_injector()
+    yield
+    reset_brokers()
+    reset_keepers()
+    faults_module.reset_injector()
+
+
+# -- WAN fault plane: seeded determinism --------------------------------------
+
+
+WAN_SPEC = ("seed=29;"
+            "link_loss:src=us:dst=eu:rate=0.3;"
+            "link_latency:node=us>eu:ms=2;"
+            "link_jitter:node=us>eu:ms=3;"
+            "region_partition:node=eu:rate=0.05")
+
+
+def _drive(injector):
+    """One fixed call schedule over the WAN points; returns the full
+    per-call outcome sequence (the firing tape)."""
+    tape = []
+    for ordinal in range(40):
+        for subscriber in ("gw_c", "dec_eu", "client_7"):
+            tape.append(injector.link_drop(
+                "us", "eu", frame_id=ordinal, scope=subscriber))
+            tape.append(round(injector.link_delay(
+                "us", "eu", frame_id=ordinal, scope=subscriber), 9))
+    for ordinal in range(40):
+        for member in ("gw_c", "dec_eu"):
+            tape.append(injector.region_partition(
+                "eu", frame_id=ordinal, scope=member))
+    return tape
+
+
+class TestWanDeterminism:
+    def test_same_seed_identical_firing_and_stats(self):
+        """The acceptance criterion: same seed, same spec -> identical
+        firing sequences AND equal faults.stats(), independent of run."""
+        first = create_injector(WAN_SPEC)
+        second = create_injector(WAN_SPEC)
+        assert _drive(first) == _drive(second)
+        stats = first.stats()
+        assert stats == second.stats()
+        # the plan actually fired: a dead injector is trivially equal
+        assert stats.get("link_loss", 0) > 0
+        assert stats.get("link_latency", 0) > 0
+        assert stats.get("region_partition", 0) > 0
+
+    def test_different_seed_changes_the_tape(self):
+        first = create_injector(WAN_SPEC)
+        other = create_injector(WAN_SPEC.replace("seed=29", "seed=30"))
+        assert _drive(first) != _drive(other)
+
+    def test_src_dst_and_node_arrow_are_the_same_link(self):
+        assert link_name("us", "eu") == "us>eu"
+        by_pair = create_injector(
+            "link_loss:src=us:dst=eu:frame=2")
+        by_node = create_injector("link_loss:node=us>eu:frame=2")
+        for injector in (by_pair, by_node):
+            fired = [injector.link_drop("us", "eu", frame_id=ordinal,
+                                        scope="s")
+                     for ordinal in range(4)]
+            assert fired == [False, False, True, False]
+        # the wrong direction never fires
+        assert not by_pair.link_drop("eu", "us", frame_id=2, scope="s")
+
+    def test_link_field_validation(self):
+        with pytest.raises(ValueError, match="BOTH src= and dst="):
+            create_injector("link_loss:src=us:rate=0.5")
+        with pytest.raises(ValueError, match="node= OR src=/dst="):
+            create_injector("link_loss:src=us:dst=eu:node=us>eu")
+
+    def test_continuous_points_default_to_unlimited(self):
+        """A link HAS latency -- no times= means every delivery, not
+        the one-shot default the transient points use."""
+        injector = create_injector("link_latency:node=us>eu:ms=1")
+        delays = [injector.link_delay("us", "eu", frame_id=ordinal,
+                                      scope="s")
+                  for ordinal in range(5)]
+        assert delays == [0.001] * 5
+
+    def test_region_partition_return_contract(self):
+        # no ms= -> -1.0 (until heal); ms= -> seconds; miss -> 0.0
+        until_heal = create_injector("region_partition:node=eu:frame=0")
+        assert until_heal.region_partition(
+            "eu", frame_id=0, scope="m") == -1.0
+        assert until_heal.region_partition(
+            "us", frame_id=0, scope="m") == 0.0
+        timed = create_injector(
+            "region_partition:node=eu:frame=0:ms=50:times=-1")
+        assert timed.region_partition(
+            "eu", frame_id=1, scope="m") == 0.0
+        assert timed.region_partition(
+            "eu", frame_id=0, scope="m") == pytest.approx(0.05)
+
+
+# -- region grammar and placement ---------------------------------------------
+
+
+class TestRegionGrammar:
+    def test_labeled_groups_parse(self):
+        policy = FederationPolicy.parse(
+            "groups=us:a,us:b,eu:c;group=eu:c")
+        assert policy.groups == ("a", "b", "c")
+        assert policy.group == "c"
+        assert policy.region_of("a") == "us"
+        assert policy.region_of("c") == "eu"
+        assert policy.region_groups("us") == ("a", "b")
+        assert policy.region_groups("eu") == ("c",)
+
+    def test_unlabeled_spec_is_backward_compatible(self):
+        policy = FederationPolicy.parse("groups=g0,g1,g2;group=g1")
+        assert all(policy.region_of(group) == ""
+                   for group in policy.groups)
+        for index in range(200):
+            stream_id = f"s{index}"
+            assert policy.owner_of(stream_id) == assign_group(
+                stream_id, policy.groups)
+
+    def test_rejections(self):
+        with pytest.raises(ValueError, match="empty group name"):
+            FederationPolicy.parse("groups=us:,eu:c")
+        with pytest.raises(ValueError, match="duplicate group names"):
+            FederationPolicy.parse("groups=us:a,eu:a")
+        with pytest.raises(ValueError, match="disagrees"):
+            FederationPolicy.parse("groups=us:a,eu:c;group=eu:a")
+        with pytest.raises(ValueError, match="AIKO410"):
+            Gateway(Process(transport_kind="loopback"),
+                    federation="groups=us:a,eu:c;group=eu:a")
+
+
+class TestRegionPlacement:
+    POLICY = FederationPolicy.parse("groups=us:a,us:b,eu:c")
+
+    def test_region_affinity_narrows_the_domain(self):
+        for index in range(200):
+            stream_id = f"s{index}"
+            assert self.POLICY.owner_of(stream_id, region="eu") == "c"
+            assert self.POLICY.owner_of(stream_id,
+                                        region="us") in ("a", "b")
+
+    def test_region_loss_remaps_only_its_streams(self):
+        """Losing eu moves ONLY eu-affine streams; every us stream and
+        every unlabeled stream owned by a survivor keeps its pin."""
+        moved = 0
+        for index in range(300):
+            stream_id = f"s{index}"
+            before = self.POLICY.owner_of(stream_id)
+            after = self.POLICY.owner_of(stream_id, lost=("c",))
+            if before != "c":
+                assert after == before, stream_id
+            else:
+                moved += 1
+                assert after in ("a", "b")
+            # declared us affinity: the eu loss changes nothing at all
+            assert (self.POLICY.owner_of(stream_id, region="us",
+                                         lost=("c",))
+                    == self.POLICY.owner_of(stream_id, region="us"))
+            # eu affinity degrades cross-region onto the survivors
+            assert self.POLICY.owner_of(stream_id, region="eu",
+                                        lost=("c",)) in ("a", "b")
+        assert moved > 0
+        with pytest.raises(ValueError, match="every group is lost"):
+            self.POLICY.owner_of("s0", lost=("a", "b", "c"))
+
+    def test_router_records_affinity_and_injects_region(self):
+        class Stub:
+            def __init__(self):
+                self.created = {}
+
+            def submit_stream(self, stream_id, **kwargs):
+                self.created[stream_id] = kwargs
+
+        stubs = {"a": Stub(), "b": Stub(), "c": Stub()}
+        router = FederationRouter(stubs,
+                                  policy="groups=us:a,us:b,eu:c")
+        group = router.submit_stream("r1", region="eu")
+        assert group == "c"
+        assert stubs["c"].created["r1"]["parameters"]["region"] == "eu"
+        # the recorded affinity sticks for later frame routing
+        assert router.group_for("r1") == "c"
+        router.fail_group("c")
+        assert router.group_for("r1") in ("a", "b")
+        router.heal_group("c")
+        assert router.group_for("r1") == "c"
+
+
+# -- link faults through the loopback broker ----------------------------------
+
+
+class _RegionClient:
+    def __init__(self, broker_name, region, name, pattern="wan/#"):
+        self.received = []
+        self.transport = LoopbackTransport(
+            on_message=lambda topic, payload: self.received.append(
+                (topic, payload)),
+            broker=broker_name)
+        self.transport.chaos_region = region
+        self.transport.chaos_name = name
+        self.transport.subscribe(pattern)
+        self.transport.connect()
+
+
+class TestLinkFaultPlane:
+    def test_link_loss_drops_only_cross_region(self, monkeypatch):
+        monkeypatch.setenv(
+            "AIKO_FAULTS", "seed=5;link_loss:src=us:dst=eu:rate=1.0")
+        faults_module.reset_injector()
+        drops_before = get_registry().counter(
+            "faults.link_drops").value
+        publisher = _RegionClient("wan_loss", "us", "pub", pattern="x")
+        local = _RegionClient("wan_loss", "us", "sub_us")
+        remote = _RegionClient("wan_loss", "eu", "sub_eu")
+        for index in range(5):
+            publisher.transport.publish("wan/t", f"m{index}")
+        get_broker("wan_loss").drain()
+        assert len(local.received) == 5, "intra-region must not drop"
+        assert remote.received == [], "rate=1.0 drops every crossing"
+        assert (get_registry().counter("faults.link_drops").value
+                - drops_before) == 5
+        stats = faults_module.get_injector().stats()
+        assert stats.get("link_loss") == 5
+
+    def test_link_latency_delays_and_counts(self, monkeypatch):
+        monkeypatch.setenv(
+            "AIKO_FAULTS", "seed=5;link_latency:src=us:dst=eu:ms=1")
+        faults_module.reset_injector()
+        delays_before = get_registry().counter(
+            "faults.link_delays").value
+        publisher = _RegionClient("wan_lat", "us", "pub", pattern="x")
+        remote = _RegionClient("wan_lat", "eu", "sub_eu")
+        for index in range(3):
+            publisher.transport.publish("wan/t", f"m{index}")
+        get_broker("wan_lat").drain()
+        assert [payload for _t, payload in remote.received] == [
+            "m0", "m1", "m2"], "latency delays, never drops"
+        assert (get_registry().counter("faults.link_delays").value
+                - delays_before) == 3
+
+    def test_lossy_link_is_deterministic_across_runs(self, monkeypatch):
+        monkeypatch.setenv(
+            "AIKO_FAULTS", "seed=11;link_loss:src=us:dst=eu:rate=0.5")
+        delivered = []
+        for run in range(2):
+            faults_module.reset_injector()
+            name = f"wan_det{run}"
+            publisher = _RegionClient(name, "us", "pub", pattern="x")
+            remote = _RegionClient(name, "eu", "sub_eu")
+            for index in range(30):
+                publisher.transport.publish("wan/t", f"m{index}")
+            get_broker(name).drain()
+            delivered.append([payload for _t, payload
+                              in remote.received])
+        assert delivered[0] == delivered[1]
+        assert 0 < len(delivered[0]) < 30, "rate=0.5 must be partial"
+
+
+class TestRegionPartitionTransport:
+    def test_whole_region_severs_as_a_unit(self, monkeypatch):
+        """One spec, per-client ordinals: EVERY eu client partitions at
+        its own first publish; us clients never do."""
+        monkeypatch.setenv(
+            "AIKO_FAULTS", "seed=3;region_partition:node=eu:frame=0")
+        faults_module.reset_injector()
+        eu_a = _RegionClient("wan_part", "eu", "eu_a")
+        eu_b = _RegionClient("wan_part", "eu", "eu_b")
+        us = _RegionClient("wan_part", "us", "us_a")
+        listener = _RegionClient("wan_part", None, "listen")
+        for client in (eu_a, eu_b, us):
+            client.transport.publish("wan/t", f"from_{client}")
+        get_broker("wan_part").drain()
+        assert eu_a.transport._partitioned
+        assert eu_b.transport._partitioned
+        assert not us.transport._partitioned
+        assert eu_a.transport.partition_dropped == 1
+        # only the us publish crossed; both eu publishes died severed
+        assert len(listener.received) == 1
+        stats = faults_module.get_injector().stats()
+        assert stats.get("region_partition") == 2
+
+    def test_ms_schedules_the_heal(self, monkeypatch):
+        monkeypatch.setenv(
+            "AIKO_FAULTS",
+            "seed=3;region_partition:node=eu:frame=0:ms=60")
+        faults_module.reset_injector()
+        eu = _RegionClient("wan_heal", "eu", "eu_a")
+        listener = _RegionClient("wan_heal", None, "listen")
+        eu.transport.publish("wan/t", "severed")
+        assert eu.transport._partitioned
+        wait_for(lambda: not eu.transport._partitioned, timeout=5)
+        eu.transport.publish("wan/t", "healed")
+        get_broker("wan_heal").drain()
+        assert [payload for _t, payload in listener.received] == [
+            "healed"]
+
+
+# -- cross-group adoption (region loss -> survivors take the streams) ---------
+
+
+class Echo(PipelineElement):
+    def process_frame(self, stream, number):
+        return StreamEvent.OKAY, {"number": int(number) + 1}
+
+
+def _echo_definition(name):
+    return {
+        "name": name,
+        "parameters": {"telemetry": False},
+        "graph": ["(echo)"],
+        "elements": [
+            {"name": "echo", "input": [{"name": "number"}],
+             "output": [{"name": "number"}],
+             "deploy": {"local": {"module": "tests.test_region",
+                                  "class_name": "Echo"}}},
+        ],
+    }
+
+
+JOURNAL = "backend=retained;interval=0.02;replay_timeout=0.2"
+GROUPS = "groups=us:a,eu:c"
+
+
+def _region_tier(processes, keeper="region_k"):
+    """Two-region tier over shared echo replicas.  Gateways are NAMED
+    after their groups so each journal root is {ns}/gateway/<group>/...
+    -- the root a survivor's note_group_lost mirrors."""
+    replicas = []
+    for index in range(2):
+        process = Process(transport_kind="loopback")
+        processes.append(process)
+        replicas.append(create_pipeline(
+            process, _echo_definition(f"region_replica{index}")))
+    gateways = {}
+    for group, region in (("a", "us"), ("c", "eu")):
+        process = Process(transport_kind="loopback")
+        processes.append(process)
+        gateways[group] = Gateway(
+            process, name=group, policy="max_inflight=64;queue=256",
+            federation=f"{GROUPS};group={region}:{group}",
+            journal=JOURNAL,
+            checkpoint=f"recovery_rate=8;keeper={keeper}")
+        for replica in replicas:
+            gateways[group].attach_replica(replica)
+    for process in processes:
+        process.run(in_thread=True)
+    return FederationRouter(gateways, policy=GROUPS), gateways, replicas
+
+
+class TestCrossGroupAdoption:
+    def test_lost_region_streams_adopt_with_warm_hints(self):
+        """Region loss end to end at the gateway layer: eu's journaled
+        streams are adopted by the us survivor (rendezvous over the
+        survivors), each with the one-shot warm-restore hint armed for
+        the client's resubmission, the foreign journal purged so the
+        healed group cannot re-pin, and frames keep serving."""
+        keeper = CheckpointKeeper("region_k")
+        assert keeper.kept_count() == 0
+        processes = []
+        try:
+            router, gateways, replicas = _region_tier(processes)
+            responses = queue.Queue()
+            eu_ids = [f"eu{index}" for index in range(3)]
+            for stream_id in eu_ids:
+                group = router.submit_stream(
+                    stream_id, region="eu", queue_response=responses,
+                    grace_time=300)
+                assert group == "c"
+                router.submit_frame(stream_id, {"number": 1},
+                                    frame_id=0)
+            us_id = "us0"
+            assert router.submit_stream(
+                us_id, region="us", queue_response=responses,
+                grace_time=300) == "a"
+            for _ in range(len(eu_ids)):
+                reply = responses.get(timeout=30)
+                assert reply[3] == "ok" and reply[2]["number"] == 2
+            gateways["c"].journal_flush()
+            wait_for(lambda: gateways["c"].journal.entry_count()
+                     >= len(eu_ids), timeout=10)
+            affinity_before = (
+                gateways["a"].telemetry.region_affinity_misses.value)
+
+            # the region dies: no clean shutdown, retained journal stays
+            gateways["c"].process.crash()
+            router.fail_group("c")
+            wait_for(lambda: gateways["a"].telemetry
+                     .region_migrations.value >= len(eu_ids),
+                     timeout=30)
+            survivor = gateways["a"]
+            for stream_id in eu_ids:
+                stream = survivor.streams[stream_id]
+                # empty-inflight adoption arms the ONE-SHOT hint: the
+                # resubmitted first frame will carry data["restore"]
+                assert stream.restore_hint == {"keeper": "region_k"}
+                assert stream.parameters.get("region") == "eu"
+            assert us_id in survivor.streams
+
+            # the client replays against the survivor: dedupe absorbs
+            # the already-delivered frame 0, frame 1 serves -- and the
+            # one-shot hint is consumed by the first dispatch
+            replays = queue.Queue()
+            for stream_id in eu_ids:
+                survivor.streams[stream_id].queue_response = replays
+                assert router.group_for(stream_id) == "a"
+                survivor.submit_frame(stream_id, {"number": 10},
+                                      frame_id=1)
+            for _ in range(len(eu_ids)):
+                reply = replays.get(timeout=30)
+                assert reply[3] == "ok" and reply[2]["number"] == 11
+            assert all(survivor.streams[stream_id].restore_hint is None
+                       for stream_id in eu_ids)
+            # cross-region adoption is the affinity MISS evidence
+            assert (survivor.telemetry.region_affinity_misses.value
+                    == affinity_before)
+
+            # heal: adopted streams STAY adopted -- a fresh eu gateway
+            # over the same journal root finds only purged tombstones
+            router.heal_group("c")
+            wait_for(lambda: "c" not in survivor._lost_groups,
+                     timeout=10)
+            process = Process(transport_kind="loopback")
+            processes.append(process)
+            healed = Gateway(
+                process, name="c", policy="max_inflight=64;queue=256",
+                federation=f"{GROUPS};group=eu:c", journal=JOURNAL)
+            for replica in replicas:
+                healed.attach_replica(replica)
+            process.run(in_thread=True)
+            router.gateways["c"] = healed
+            get_broker().drain()
+            time.sleep(0.1)   # retained mirror warm-up
+            assert healed.recover_now() == 0, (
+                "purged journal records must not re-pin adopted "
+                "streams (double-pin)")
+            assert not set(eu_ids) & set(healed.streams)
+            # placement flows back: NEW eu streams land on the healed
+            # group again
+            fresh = queue.Queue()
+            new_id = "eu_new"
+            assert router.submit_stream(
+                new_id, region="eu", queue_response=fresh,
+                grace_time=300) == "c"
+            wait_for(lambda: new_id in healed.streams, timeout=10)
+        finally:
+            for process in processes:
+                process.terminate()
+
+    def test_heal_before_adoption_leaves_ownership_alone(self):
+        """fail_group then heal_group inside the replay window: the
+        scheduled _adopt_group_ready finds the group healed and adopts
+        NOTHING -- no stream ever double-pins mid-migration."""
+        keeper = CheckpointKeeper("region_k")
+        assert keeper is not None
+        processes = []
+        try:
+            router, gateways, _replicas = _region_tier(processes)
+            responses = queue.Queue()
+            router.submit_stream("eu0", region="eu",
+                                 queue_response=responses,
+                                 grace_time=300)
+            gateways["c"].journal_flush()
+            wait_for(lambda: gateways["c"].journal.entry_count() >= 1,
+                     timeout=10)
+            router.fail_group("c")
+            router.heal_group("c")     # back before the window closed
+            time.sleep(0.5)            # let any scheduled adoption fire
+            assert gateways["a"].adopt_group_now("c") == 0
+            assert "eu0" not in gateways["a"].streams
+            assert gateways["a"].telemetry.region_migrations.value == 0
+            assert "eu0" in gateways["c"].streams
+        finally:
+            for process in processes:
+                process.terminate()
+
+
+# -- multi-tenant admission isolation -----------------------------------------
+
+
+class TestTenantIsolation:
+    def test_grammar_and_bucket_lookup(self):
+        policy = AdmissionPolicy.parse(
+            "max_inflight=4;bucket:tenant:gold=100/20;"
+            "bucket:tenant:free=10/4;bucket:2=10/4")
+        assert sorted(policy.tenant_buckets) == ["free", "gold"]
+        assert policy.tenant_bucket_for("gold").burst == 20
+        assert policy.tenant_bucket_for("unnamed") is None
+        assert policy.tenant_bucket_for(None) is None
+        assert policy.bucket_for(2) is not None
+        with pytest.raises(ValueError, match="non-empty tenant name"):
+            AdmissionPolicy.parse("bucket:tenant:=5/2")
+
+    def test_storm_exhausts_only_its_own_tenant(self):
+        """The isolation proof: a 2x storm from one tenant sheds
+        rate_limited_tenant against ITS bucket while the other
+        tenant's admission (and tenant-less streams) are untouched --
+        and completed frames land per-tenant SLO counters."""
+        process_r = Process(transport_kind="loopback")
+        replica = create_pipeline(process_r,
+                                  _echo_definition("tenant_replica"))
+        process_g = Process(transport_kind="loopback")
+        gateway = Gateway(
+            process_g, name="tenants",
+            policy=("max_inflight=64;queue=256;"
+                    "bucket:tenant:noisy=0.1/2;"
+                    "bucket:tenant:quiet=0.1/2"))
+        gateway.attach_replica(replica)
+        for process in (process_r, process_g):
+            process.run(in_thread=True)
+        try:
+            responses = queue.Queue()
+
+            def submit(stream_id, tenant):
+                parameters = {"slo_ms": 60000.0}
+                if tenant:
+                    parameters["tenant"] = tenant
+                gateway.submit_stream(stream_id, parameters,
+                                      queue_response=responses,
+                                      grace_time=300)
+
+            # the storm: 2x the noisy tenant's burst.  Admitted creates
+            # reply nothing until a frame; sheds reply immediately with
+            # the typed reason
+            for index in range(4):
+                submit(f"noisy{index}", "noisy")
+            shed = [responses.get(timeout=30) for _ in range(2)]
+            assert all(r[3] == "overloaded"
+                       and r[2]["reason"] == "rate_limited_tenant"
+                       for r in shed)
+            wait_for(lambda: len(gateway.streams) == 2, timeout=10)
+
+            # the OTHER tenant's budget is untouched: both admit
+            for index in range(2):
+                submit(f"quiet{index}", "quiet")
+            for index in range(2):
+                gateway.submit_frame(f"quiet{index}", {"number": 5},
+                                     frame_id=0)
+            oks = 0
+            while oks < 2:
+                reply = responses.get(timeout=30)
+                assert reply[3] == "ok", reply
+                oks += 1
+            # tenant-less and unbucketed-tenant streams admit freely
+            submit("anon", None)
+            submit("bronze0", "bronze")
+            gateway.submit_frame("anon", {"number": 1}, frame_id=0)
+            reply = responses.get(timeout=30)
+            assert reply[3] == "ok"
+            # per-tenant SLO attainment rode the completions
+            registry = gateway.telemetry.registry
+            assert registry.counter("gateway.slo_ok:t:quiet").value == 2
+            assert registry.counter("gateway.slo_ok:p0").value >= 3
+            assert (registry.counter("gateway.slo_ok:t:noisy").value
+                    == 0)
+        finally:
+            for process in (process_r, process_g):
+                process.terminate()
+
+
+# -- destroy-while-paced: the accounting regression ---------------------------
+
+
+class TestDestroyWhilePaced:
+    def test_destroyed_stream_never_leaks_a_paced_replay(self):
+        """A stream destroyed while its recovery wave is still
+        scheduled: the pending-cohort gauge drops immediately, the
+        scheduled _paced_replay is a no-op, and the dead stream's
+        frames are never dispatched to the survivor."""
+        # replica processes NEVER run: submitted frames stay inflight,
+        # so the failover has something to pace
+        process_r0 = Process(transport_kind="loopback")
+        replica0 = create_pipeline(process_r0,
+                                   _echo_definition("paced_r0"))
+        process_r1 = Process(transport_kind="loopback")
+        replica1 = create_pipeline(process_r1,
+                                   _echo_definition("paced_r1"))
+        process_g = Process(transport_kind="loopback")
+        gateway = Gateway(process_g, name="paced",
+                          policy="max_inflight=16;queue=32",
+                          checkpoint="recovery_rate=2;keeper=paced_k")
+        gateway.attach_replica(replica0)
+        process_g.run(in_thread=True)
+        try:
+            ids = [f"pc{index}" for index in range(4)]
+            for stream_id in ids:
+                gateway.submit_stream(stream_id, {},
+                                      queue_response=queue.Queue(),
+                                      grace_time=300)
+                gateway.submit_frame(stream_id, {"number": 1},
+                                     frame_id=0)
+            wait_for(lambda: sum(
+                len(stream.inflight)
+                for stream in gateway.streams.values()) == 4,
+                timeout=10)
+            gateway.attach_replica(replica1)
+            gateway.post_message("_replica_lost",
+                                 [replica0.topic_path, "test kill"])
+            # recovery_rate=2 over 4 migrated streams: 2 replay
+            # immediately, 2 join the paced cohort
+            gauge = gateway.telemetry.recovery_paced_pending
+            wait_for(lambda: gauge.value == 2, timeout=10)
+            survivor = gateway.replicas[replica1.topic_path]
+            assert survivor.routed == 2
+            # destroy the LATER-scheduled cohort member (insertion
+            # order = schedule order) before its wave fires
+            victim = list(gateway._paced_frames)[-1]
+            gateway.post_message("destroy_stream", [victim])
+            wait_for(lambda: victim not in gateway.streams, timeout=10)
+            assert gauge.value == 1, (
+                "destroy must drop the stream's cohort entry")
+            # the remaining wave fires; the victim's never does
+            wait_for(lambda: gauge.value == 0, timeout=10)
+            wait_for(lambda: survivor.routed == 3, timeout=10)
+            time.sleep(0.3)    # past the victim's original schedule
+            assert survivor.routed == 3, (
+                "a destroyed stream's paced replay must be a no-op")
+            assert not gateway._paced_frames
+        finally:
+            # the replica processes were never run (that is the point:
+            # frames had to stay inflight) -- only the gateway's stops
+            process_g.terminate()
+
+
+# -- soak harness machinery ---------------------------------------------------
+
+
+class TestSoakHarness:
+    def test_short_window_runs_clean_and_writes_ledger(
+            self, monkeypatch, tmp_path):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        import bench
+        monkeypatch.setattr(bench, "SMOKE", True)
+        ledger_path = tmp_path / "soak_ledger.json"
+        monkeypatch.setenv("AIKO_SOAK_SECONDS", "3")
+        monkeypatch.setenv("AIKO_SOAK_LEDGER", str(ledger_path))
+        block = bench.bench_soak(None)
+        assert block["findings"] == [], block["findings"]
+        assert block["drift_ok"] is True
+        assert block["waves"] >= 1
+        assert block["probes"] == block["waves"]
+        assert block["streams_total"] > 0
+        entry = block["ledger"][-1]
+        assert entry["journal_entries"] == 0
+        assert entry["pool_free"] + entry["pool_cached"] == \
+            entry["pool_capacity"]
+        artifact = json.loads(ledger_path.read_text())
+        assert artifact["findings"] == []
+        assert len(artifact["ledger"]) == block["waves"]
