@@ -17,10 +17,18 @@
 #   policy  AIKO4xx  operator grammars (fault-tolerance parameters,
 #                    fault-injection specs, gateway admission policy)
 #                    verified through the shared directive-grammar core
+#   code    AIKO6xx  whole-package static concurrency lint over Python
+#                    SOURCE (not definitions): thread-role inference
+#                    over the actor fleet, unsynchronized container
+#                    iteration, cross-role check-then-act, blocking
+#                    under lock, lock-order inversion, mutable
+#                    class-level defaults (aiko lint --code)
 #
 # `Pipeline.__init__` runs the cheap passes (graph + policy) at
 # construction unless the pipeline parameter `validate` is false;
-# `aiko lint` runs all four over definition files and CI artifacts.
+# `aiko lint` runs all four over definition files and CI artifacts;
+# `aiko lint --code` runs the AIKO6xx pass over source trees against
+# a committed baseline file.
 
 from __future__ import annotations
 
@@ -28,6 +36,9 @@ import contextlib
 import os
 import sys
 
+from .concurrency import (                                     # noqa: F401
+    apply_baseline, finding_fingerprint, load_baseline, role_map,
+    run_code_pass, write_baseline)
 from .diagnostics import (                                     # noqa: F401
     AnalysisReport, Diagnostic, RULES, severity_of)
 from .grammar import (                                         # noqa: F401
@@ -40,6 +51,8 @@ __all__ = [
     "DirectiveGrammar", "Field", "GrammarError",
     "PortSpec", "SpecError", "parse_port_type",
     "CHEAP_PASSES", "ALL_PASSES", "analyze_definition",
+    "run_code_pass", "role_map", "finding_fingerprint",
+    "load_baseline", "apply_baseline", "write_baseline",
 ]
 
 CHEAP_PASSES = ("graph", "policy")

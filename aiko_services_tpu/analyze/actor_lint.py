@@ -31,7 +31,7 @@ import textwrap
 
 from .diagnostics import AnalysisReport, Diagnostic
 
-__all__ = ["run_actor_pass"]
+__all__ = ["run_actor_pass", "statement_suppressed"]
 
 # dotted-call patterns that block the calling thread.  Matched against
 # the rendered dotted name of Call nodes ("time.sleep", "socket.create_
@@ -73,11 +73,22 @@ def _dotted_name(node) -> str | None:
     return None
 
 
-def _suppressed(source_lines, ast_node) -> bool:
-    line_index = getattr(ast_node, "lineno", 0) - 1
-    if 0 <= line_index < len(source_lines):
-        return "# aiko: allow" in source_lines[line_index]
+def statement_suppressed(source_lines, ast_node) -> bool:
+    """True when ANY line a statement spans carries "# aiko: allow" --
+    a multi-line call or comprehension is suppressible on whichever of
+    its lines the comment reads best (shared with the AIKO6xx
+    concurrency pass in concurrency.py)."""
+    start = getattr(ast_node, "lineno", 0) - 1
+    if start < 0 or start >= len(source_lines):
+        return False
+    end = getattr(ast_node, "end_lineno", None) or (start + 1)
+    for index in range(start, min(end, len(source_lines))):
+        if "# aiko: allow" in source_lines[index]:
+            return True
     return False
+
+
+_suppressed = statement_suppressed  # historical internal name
 
 
 class _MethodScanner(ast.NodeVisitor):

@@ -2,7 +2,8 @@
 #
 # Every finding carries a rule code (AIKO1xx graph/ports, AIKO2xx
 # shape/dtype flow, AIKO3xx element/actor safety, AIKO4xx policy
-# grammars, AIKO5xx profile-guided tuning), a severity, and a location
+# grammars, AIKO5xx profile-guided tuning, AIKO6xx static
+# concurrency), a severity, and a location
 # (definition / element / port),
 # so CI can diff reports across commits and operators can suppress a
 # rule by code (element or pipeline parameter `lint_ignore`).
@@ -79,6 +80,19 @@ RULES = {
                            "definition"),
     "AIKO503": ("info", "trace metadata absent or not joinable against "
                         "the static graph"),
+    # -- AIKO6xx: static concurrency (analyze/concurrency.py) ------------
+    "AIKO600": ("info", "concurrency pass note (stale baseline entry "
+                        "or unreadable source)"),
+    "AIKO601": ("warning", "unsynchronized iteration of a container "
+                           "attribute mutated from another thread "
+                           "role"),
+    "AIKO602": ("warning", "check-then-act on a shared attribute "
+                           "across thread roles without a lock"),
+    "AIKO603": ("warning", "blocking call while holding a lock"),
+    "AIKO604": ("warning", "lock-order inversion: acquire-graph cycle "
+                           "across methods"),
+    "AIKO605": ("warning", "mutable class-level default mutated "
+                           "through self"),
 }
 
 

@@ -139,22 +139,42 @@ def dashboard(transport: str | None, snapshot: bool, wait: float) -> None:
 @click.option("--golden", default=None,
               type=click.Path(exists=True, file_okay=False),
               help="Verify a corpus of deliberately-broken definitions:"
-                   " each <code>_*.json must produce that rule code")
+                   " each <code>_*.json (or <code>_*.py for the AIKO6xx"
+                   " concurrency pass) must produce that rule code")
+@click.option("--code", "code_mode", is_flag=True,
+              help="Concurrency lint (AIKO6xx) over Python SOURCE "
+                   "files/trees instead of pipeline definitions")
+@click.option("--baseline", default=None, type=click.Path(),
+              help="(--code) accepted-findings baseline JSON: matches "
+                   "are filtered, stale entries surface as AIKO600")
+@click.option("--update-baseline", "update_baseline", is_flag=True,
+              help="(--code) rewrite --baseline from the current "
+                   "findings and exit 0")
 def lint(sources, strict, fmt, output, passes_option, bench_configs,
-         golden) -> None:
+         golden, code_mode, baseline, update_baseline) -> None:
     """Statically verify pipeline definitions (analyze/ subsystem).
 
     SOURCES are definition JSON files or directories (searched
     recursively for *.json).  Four passes: graph/port dataflow
     (AIKO1xx), tensor-spec shape/dtype flow (AIKO2xx, including a
     jax.eval_shape dry-run of element device programs), element/actor
-    safety (AIKO3xx), and policy grammars (AIKO4xx).  Exit status: 0
-    clean, 1 findings (with --strict, warnings count), 2 usage error.
+    safety (AIKO3xx), and policy grammars (AIKO4xx).  With --code,
+    SOURCES are Python files/trees and the AIKO6xx static concurrency
+    pass runs instead (thread-role inference over the actor fleet;
+    see README "Concurrency model").  Exit status: 0 clean, 1 findings
+    (with --strict, warnings count), 2 usage error.
     """
     import sys
     from pathlib import Path
 
     from .analyze import ALL_PASSES, AnalysisReport, analyze_definition
+
+    if code_mode:
+        sys.exit(_lint_code(sources, strict, fmt, output, baseline,
+                            update_baseline))
+    if baseline or update_baseline:
+        click.echo("--baseline/--update-baseline need --code", err=True)
+        sys.exit(2)
 
     passes = (tuple(part.strip() for part in passes_option.split(",")
                     if part.strip())
@@ -208,22 +228,74 @@ def lint(sources, strict, fmt, output, passes_option, bench_configs,
     sys.exit(1 if report.failures(strict=strict) else 0)
 
 
+def _lint_code(sources, strict, fmt, output, baseline,
+               update_baseline) -> int:
+    """`aiko lint --code`: the AIKO6xx static concurrency pass over
+    Python source trees, optionally diffed against a committed
+    baseline of accepted findings.  Returns the exit status."""
+    import sys
+    from pathlib import Path
+
+    from .analyze import (
+        apply_baseline, load_baseline, run_code_pass, write_baseline)
+
+    if not sources:
+        click.echo("nothing to lint (give Python files or directories)",
+                   err=True)
+        return 2
+    missing = [source for source in sources
+               if not Path(source).exists()]
+    if missing:
+        click.echo(f"no such path(s): {missing}", err=True)
+        return 2
+    report = run_code_pass([Path(source) for source in sources])
+    if update_baseline:
+        if not baseline:
+            click.echo("--update-baseline needs --baseline PATH",
+                       err=True)
+            return 2
+        count = write_baseline(baseline, report)
+        click.echo(f"baseline written: {count} accepted finding(s) -> "
+                   f"{baseline}")
+        return 0
+    if baseline:
+        try:
+            entries = load_baseline(baseline)
+        except (OSError, ValueError) as error:
+            click.echo(f"cannot read baseline: {error}", err=True)
+            return 2
+        filtered = apply_baseline(report, entries)
+        click.echo(f"baseline: {filtered} accepted finding(s) "
+                   f"filtered", err=True)
+    rendered = (report.to_json() if fmt == "json" else report.render())
+    click.echo(rendered)
+    if output:
+        Path(output).write_text(
+            rendered if rendered.endswith("\n") else rendered + "\n")
+    return 1 if report.failures(strict=strict) else 0
+
+
 def _lint_golden(corpus: "Path", passes) -> int:
     """Golden-corpus mode: every `<code>_*.json` in the corpus must
     yield a finding with that code -- the proof each lint rule still
-    fires.  Returns the exit status."""
-    from .analyze import RULES, analyze_definition
+    fires.  `<code>_*.py` fixtures run through the AIKO6xx concurrency
+    pass the same way.  Returns the exit status."""
+    from .analyze import RULES, analyze_definition, run_code_pass
 
     failures = 0
     checked = 0
-    for path in sorted(corpus.glob("*.json")):
+    for path in sorted(corpus.glob("*.json")) + sorted(
+            corpus.glob("*.py")):
         expected = path.stem.split("_", 1)[0].upper()
         if expected not in RULES:
             click.echo(f"SKIP {path.name}: no rule code prefix")
             continue
         checked += 1
-        report = analyze_definition(path, passes=passes,
-                                    source_path=str(path))
+        if path.suffix == ".py":
+            report = run_code_pass([path], root=corpus)
+        else:
+            report = analyze_definition(path, passes=passes,
+                                        source_path=str(path))
         codes = {diagnostic.code for diagnostic in report.findings}
         if expected in codes:
             click.echo(f"ok   {path.name}: {expected} fired")

@@ -1039,15 +1039,15 @@ class LMGenerate(ComputeElement):
     def stop_stream(self, stream, stream_id):
         engine = getattr(self, "_engine", None)
         if engine is not None:
-            for key in [key for key in self._engine_frames
+            for key in [key for key in list(self._engine_frames)
                         if key[0] == stream_id]:
-                del self._engine_frames[key]
+                self._engine_frames.pop(key, None)
             engine.cancel(lambda rid: rid[0] == stream_id)
         prefill = getattr(self, "_prefill_engine", None)
         if prefill is not None:
-            for key in [key for key in self._prefill_frames
+            for key in [key for key in list(self._prefill_frames)
                         if key[0] == stream_id]:
-                del self._prefill_frames[key]
+                self._prefill_frames.pop(key, None)
             prefill.cancel(lambda rid: rid[0] == stream_id)
         return super().stop_stream(stream, stream_id)
 

@@ -303,7 +303,7 @@ class PipelineElement(Actor):
                            max(backoff_ms, 0.0) / 1000.0)
 
     def stop(self) -> None:
-        for handle in self._generators.values():
+        for handle in list(self._generators.values()):
             handle.terminate()
         self._generators.clear()
         super().stop()

@@ -2038,7 +2038,7 @@ class Pipeline(Actor):
             stream_id: {"frame_id": stream.frame_id,
                         "parameters": json_safe(stream.parameters),
                         "graph_path": stream.graph_path}
-            for stream_id, stream in self.streams.items()}
+            for stream_id, stream in list(self.streams.items())}
         return checkpointer.save(
             step, states,
             metadata={"pipeline": self.definition.name,

@@ -141,7 +141,7 @@ class Process:
                      retain=True)
         if (self.registrar
                 and self.connection.is_connected(ConnectionState.REGISTRAR)):
-            for service in self._services.values():
+            for service in list(self._services.values()):
                 self._register_service(service.service_fields())
         else:
             # no primary in view: the bootstrap handshake re-registers
@@ -257,8 +257,7 @@ class Process:
             if self.connection.is_connected(ConnectionState.TRANSPORT):
                 self.connection.update_state(ConnectionState.TRANSPORT)
             # services will re-register when a new primary appears
-            self._pending_registrations = [
-                service for service in self._services.values()]
+            self._pending_registrations = list(self._services.values())
 
     def announce_registrar(self, topic_path: str) -> None:
         """Publish the retained registrar-found bootstrap record (called by

@@ -335,7 +335,7 @@ class ECConsumer:
             self._notify("sync", None, None)
 
     def _notify(self, command, name, value) -> None:
-        for handler in self._change_handlers:
+        for handler in list(self._change_handlers):
             handler(self, command, name, value)
 
     def terminate(self) -> None:
@@ -366,9 +366,10 @@ class ServicesCache:
             handler("add", fields)
 
     def remove_handler(self, handler) -> None:
-        self._handlers = [(service_filter, existing)
-                          for service_filter, existing in self._handlers
-                          if existing is not handler]
+        self._handlers = [
+            (service_filter, existing)
+            for service_filter, existing in list(self._handlers)
+            if existing is not handler]
 
     def _connection_handler(self, connection, state) -> None:
         if (state == ConnectionState.REGISTRAR

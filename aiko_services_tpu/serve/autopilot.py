@@ -253,10 +253,9 @@ class AutoPilot:
             self.gateway.process.event.remove_timer_handler(
                 self._timer_fired)
             self._timer_installed = False
-        if self._lease is not None:
-            if not self._lease.expired:
-                self._lease.terminate()
-            self._lease = None
+        lease, self._lease = self._lease, None
+        if lease is not None and not lease.expired:
+            lease.terminate()
 
     def shutdown(self) -> None:
         self.stop()
@@ -302,8 +301,9 @@ class AutoPilot:
             self.gateway.process.publish(
                 f"{topic}/in",
                 generate("publish_trace", [self._response_topic]))
-        if self._lease is not None and not self._lease.expired:
-            self._lease.terminate()
+        lease = self._lease
+        if lease is not None and not lease.expired:
+            lease.terminate()
         self._lease = Lease(
             self.gateway.process.event, max(self.policy.wait_s, 0.05),
             f"autopilot-{round_id}",
@@ -341,10 +341,9 @@ class AutoPilot:
         if round_id != self._round or self._decided_round >= round_id:
             return
         self._decided_round = round_id
-        if self._lease is not None:
-            if not self._lease.expired:
-                self._lease.terminate()
-            self._lease = None
+        lease, self._lease = self._lease, None
+        if lease is not None and not lease.expired:
+            lease.terminate()
         documents = dict(self._pending)
         self._pending = {}
         if self._expected and len(documents) < self._expected:

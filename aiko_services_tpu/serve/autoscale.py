@@ -555,12 +555,12 @@ class AutoScaler:
     def stop(self) -> None:
         self._stopped = True
         self.gateway.process.event.remove_timer_handler(self._tick)
-        for record in self._pending_spawns.values():
+        for record in list(self._pending_spawns.values()):
             lease = record.pop("lease", None)
             if lease is not None:
                 lease.terminate()
         self._pending_spawns.clear()
-        for lease in self._retiring:
+        for lease in list(self._retiring):
             lease.terminate()
         self._retiring.clear()
         # drains caught mid-linger: their backing processes still

@@ -659,7 +659,7 @@ class Gateway(Actor):
                 and not self._buckets_dirty):
             return
         records = {}
-        for stream_id in self._journal_dirty:
+        for stream_id in list(self._journal_dirty):
             stream = self.streams.get(stream_id)
             if stream is not None:
                 records[stream_id] = self._journal_record(stream)
@@ -712,7 +712,8 @@ class Gateway(Actor):
 
     def _bucket_levels(self) -> dict:
         return {str(priority): round(bucket.tokens, 6)
-                for priority, bucket in self.policy.buckets.items()}
+                for priority, bucket
+                in list(self.policy.buckets.items())}
 
     def _journal_recover(self) -> None:
         """Mailbox continuation of the restart path (non-HA journaled
@@ -770,8 +771,8 @@ class Gateway(Actor):
             # adopted streams must land on the exact knob settings the
             # previous primary had applied
             self.autopilot.adopt_journal()
-        if records and not any(not replica.dead
-                               for replica in self.replicas.values()):
+        if records and not any(not replica.dead for replica
+                               in list(self.replicas.values())):
             # cold start after a FULL outage: the pool is empty because
             # rediscovery is still in flight, and adopting now would
             # hard-fail (and forget) every journaled stream.  Wait one
@@ -951,7 +952,7 @@ class Gateway(Actor):
         if not mine:
             return 0
         if not any(not replica.dead
-                   for replica in self.replicas.values()):
+                   for replica in list(self.replicas.values())):
             # the pool is empty (the outage took our replicas too):
             # retry like the cold-start path; record expiry bounds it
             self.post_message_later(
@@ -1191,7 +1192,7 @@ class Gateway(Actor):
             # seq keeps the parked entries draining in order).  Frames
             # that were still PARKED at death are already queued -- they
             # drain to the new replica through the re-pin above
-            parked_ids = {item[3] for item in self._parked
+            parked_ids = {item[3] for item in list(self._parked)
                           if item[2] == stream_id}
             already_paced = stream_id in self._paced_frames
             replay_ids = []
@@ -1339,7 +1340,7 @@ class Gateway(Actor):
         still loses (placeable() filtered it out entirely, or its raw
         load dwarfs the discount): affinity degrades to plain
         balancing, never to a hot spot."""
-        candidates = [replica for replica in self.replicas.values()
+        candidates = [replica for replica in list(self.replicas.values())
                       if replica.placeable(now, self.policy)
                       and replica.pool_role() != "prefill"]
         if not candidates:
@@ -1375,7 +1376,7 @@ class Gateway(Actor):
         (pool empty/saturated -- the frame goes straight to its decode
         replica and prefills locally; disaggregation degrades to
         colocation, never to a stall)."""
-        candidates = [replica for replica in self.replicas.values()
+        candidates = [replica for replica in list(self.replicas.values())
                       if replica.pool_role() == "prefill"
                       and not replica.dead and not replica.draining
                       and replica.fresh(now, self.policy.stale_after_s)
@@ -1388,7 +1389,7 @@ class Gateway(Actor):
         """Least-loaded LIVE decode replica ignoring saturation/
         staleness: the failover fallback (availability beats load
         hygiene when the alternative is destroying a stream)."""
-        candidates = [replica for replica in self.replicas.values()
+        candidates = [replica for replica in list(self.replicas.values())
                       if not replica.dead
                       and replica.pool_role() != "prefill"]
         if not candidates:
@@ -1643,7 +1644,7 @@ class Gateway(Actor):
         if stream.lease is not None:
             stream.lease.terminate()
             stream.lease = None
-        parked_ids = {item[3] for item in self._parked
+        parked_ids = {item[3] for item in list(self._parked)
                       if item[2] == stream_id}
         # paced failover replays that never fired behave like parked
         # entries: in inflight, but no replica slot was ever taken.
@@ -1656,7 +1657,7 @@ class Gateway(Actor):
             self.telemetry.recovery_paced_pending.set(
                 len(self._paced_frames))
         if stream.parked:
-            self._parked = [item for item in self._parked
+            self._parked = [item for item in list(self._parked)
                             if item[2] != stream_id]
             stream.parked = 0
             self._note_queue_depth()
@@ -1887,7 +1888,7 @@ class Gateway(Actor):
                     continue
                 # only the stream's OLDEST parked frame may dispatch
                 oldest = min(
-                    (other for other in self._parked
+                    (other for other in list(self._parked)
                      if other[2] == stream_id),
                     default=item)
                 if oldest != item:
@@ -1907,7 +1908,7 @@ class Gateway(Actor):
         self.telemetry.parked.set(len(self._parked))
         if self.telemetry.enabled:
             depths: dict[int, int] = {}
-            for priority, _, _, _ in self._parked:
+            for priority, _, _, _ in list(self._parked):
                 depths[priority] = depths.get(priority, 0) + 1
             # zero-fill priorities reported before: a drained priority
             # must read 0, not its last nonzero value, in the snapshot
@@ -1937,7 +1938,7 @@ class Gateway(Actor):
         self.telemetry.record_throttle_span(rate)
         counter = (self.telemetry.throttled if rate > 0
                    else self.telemetry.unthrottled)
-        for stream in self.streams.values():
+        for stream in list(self.streams.values()):
             throttling = rate > 0
             if stream.throttled == throttling:
                 continue
@@ -2152,7 +2153,7 @@ class Gateway(Actor):
         re-dispatch here would race them -- the stale prefill response
         would arrive against a de-staged entry and be DELIVERED to the
         client as the frame's final output."""
-        for stream in self.streams.values():
+        for stream in list(self.streams.values()):
             # a restarted prefill process must get a fresh create
             stream.prefill_created.discard(topic_path)
             if not redispatch:
@@ -2206,7 +2207,7 @@ class Gateway(Actor):
             self.telemetry.recovery_paced_pending.set(
                 len(self._paced_frames))
         if stream.parked:
-            self._parked = [item for item in self._parked
+            self._parked = [item for item in list(self._parked)
                             if item[2] != stream.stream_id]
             stream.parked = 0
             self._note_queue_depth()
@@ -2269,7 +2270,7 @@ class Gateway(Actor):
         re-read per batch flush / checkpoint tick, so the new value
         takes effect on the next frame without a restart."""
         updated = 0
-        for replica in self.replicas.values():
+        for replica in list(self.replicas.values()):
             if replica.dead or replica.draining:
                 continue
             if replica.pipeline is not None:
@@ -2362,7 +2363,7 @@ class Gateway(Actor):
         self.telemetry.stop()
         for stream_id in list(self.streams):
             self.destroy_stream(stream_id)
-        for journal in self._foreign_journals.values():
+        for journal in list(self._foreign_journals.values()):
             journal.stop()
         self._foreign_journals.clear()
         if self.journal is not None:

@@ -1,0 +1,32 @@
+# Golden fixture: AIKO601 -- unsynchronized iteration of a container
+# attribute mutated from another thread role.
+#
+# Historical repro: `Pipeline.load()` iterated the live `streams` map
+# while the event-loop timer reaped finished streams underneath it --
+# "RuntimeError: dictionary changed size during iteration" on a
+# gateway-driven restore.  The fix is a `list()` snapshot before the
+# loop; this fixture preserves the broken shape so the rule keeps
+# firing.
+
+
+class Pipeline:  # stand-in fleet base so the class is analyzed
+    pass
+
+
+class ReplayPipeline(Pipeline):
+
+    def __init__(self):
+        self.streams = {}
+        self.add_timer_handler(self._reap, 1.0)
+
+    def _reap(self):
+        # timer role: mutates the stream map on the event loop
+        for stream_id in list(self.streams):
+            if self.streams[stream_id] is None:
+                del self.streams[stream_id]
+
+    def load(self, checkpoint):
+        # wire role (public, callable from any thread): live iteration
+        # of the same map the timer mutates -> AIKO601
+        for stream_id, stream in self.streams.items():
+            stream.restore(checkpoint, stream_id)

@@ -1,0 +1,24 @@
+# Golden fixture: AIKO602 -- check-then-act on a shared attribute
+# across thread roles without a lock.  The timer may expire the
+# session between the `is not None` check and the dereference.
+
+
+class Worker:  # stand-in fleet base so the class is analyzed
+    pass
+
+
+class SessionWorker(Worker):
+
+    def __init__(self):
+        self._session = None
+        self.add_timer_handler(self._expire, 5.0)
+
+    def _expire(self):
+        # timer role: drops the session
+        self._session = None
+
+    def lookup(self, key):
+        # wire role: TOCTOU against the timer -> AIKO602
+        if self._session is not None:
+            return self._session.fetch(key)
+        return None

@@ -1,0 +1,21 @@
+# Golden fixture: AIKO603 -- blocking call while holding a lock.
+# Sleeping under the mutex stalls every thread contending for it.
+
+import threading
+import time
+
+
+class Keeper:  # stand-in fleet base so the class is analyzed
+    pass
+
+
+class SnapshotKeeper(Keeper):
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._blobs = {}
+
+    def flush(self):
+        with self._lock:
+            time.sleep(0.5)  # AIKO603: blocking while holding _lock
+            self._blobs.clear()

@@ -1,0 +1,27 @@
+# Golden fixture: AIKO604 -- lock-order inversion.  `credit` takes
+# A then B; `debit` takes B then A: two threads interleaving the
+# outer acquires deadlock.
+
+import threading
+
+
+class Manager:  # stand-in fleet base so the class is analyzed
+    pass
+
+
+class LedgerManager(Manager):
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._balance = 0
+
+    def credit(self, amount):
+        with self._lock_a:
+            with self._lock_b:
+                self._balance += amount
+
+    def debit(self, amount):
+        with self._lock_b:  # AIKO604: reversed acquire order
+            with self._lock_a:
+                self._balance -= amount

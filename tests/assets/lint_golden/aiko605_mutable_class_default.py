@@ -1,0 +1,15 @@
+# Golden fixture: AIKO605 -- mutable class-level default mutated
+# through self.  Every instance shares ONE list; `join` on one actor
+# is visible from (and races with) every other instance.
+
+
+class Actor:  # stand-in fleet base so the class is analyzed
+    pass
+
+
+class RosterActor(Actor):
+
+    members = []  # shared across instances
+
+    def join(self, name):
+        self.members.append(name)  # AIKO605

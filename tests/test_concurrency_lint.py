@@ -1,0 +1,387 @@
+# Thread-role-aware static race detector (ISSUE 20): the AIKO6xx
+# concurrency pass over Python source -- role inference from dispatch
+# registration sites, the five rule families on golden fixtures
+# (including the historical `Pipeline.load()` live-dict repro),
+# baseline add/expire, `# aiko: allow` statement suppression, and
+# byte-identical JSON reports -- plus churn-storm regression tests for
+# the in-tree `list()`-snapshot fixes the pass surfaced.
+
+import ast
+import json
+import threading
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from aiko_services_tpu.analyze import (
+    apply_baseline, finding_fingerprint, load_baseline, role_map,
+    run_code_pass, write_baseline)
+from aiko_services_tpu.analyze.actor_lint import statement_suppressed
+
+REPO = Path(__file__).resolve().parents[1]
+PACKAGE = REPO / "aiko_services_tpu"
+GOLDEN = REPO / "tests" / "assets" / "lint_golden"
+BASELINE = REPO / "tests" / "assets" / "lint_code_baseline.json"
+
+_ROLE_SOURCE = '''
+import threading
+
+
+class PumpActor:
+
+    def __init__(self):
+        self.add_mailbox_handler(self._on_mail, "topic")
+        self.add_timer_handler(self._tick, 1.0)
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _on_mail(self, message):
+        self._log(message)
+
+    def _tick(self):
+        pass
+
+    def _drain(self):
+        pass
+
+    def _log(self, message):
+        pass
+
+    def expose(self):
+        pass
+
+    def _manual(self):  # aiko: role=worker
+        pass
+'''
+
+
+class TestRoleInference:
+    def test_registration_sites_and_wire(self):
+        roles = role_map(_ROLE_SOURCE)["PumpActor"]
+        assert roles["_on_mail"] == ["mailbox"]
+        assert roles["_tick"] == ["timer"]
+        assert roles["_drain"] == ["worker:_drain"]
+        assert roles["expose"] == ["wire"]
+        assert roles["__init__"] == []      # dunders carry no role
+
+    def test_roles_propagate_through_self_calls(self):
+        roles = role_map(_ROLE_SOURCE)["PumpActor"]
+        # _log is only ever called from the mailbox handler
+        assert roles["_log"] == ["mailbox"]
+
+    def test_explicit_role_comment_escape_hatch(self):
+        roles = role_map(_ROLE_SOURCE)["PumpActor"]
+        assert roles["_manual"] == ["worker"]
+
+    def test_role_comment_above_def_line(self):
+        source = (
+            "class FlushActor:\n"
+            "    # aiko: role=timer\n"
+            "    def flush(self):\n"
+            "        pass\n")
+        assert role_map(source)["FlushActor"]["flush"] == ["timer"]
+
+
+class TestRuleFixtures:
+    @pytest.mark.parametrize("code,stem", [
+        ("AIKO601", "aiko601_live_dict_iteration"),
+        ("AIKO602", "aiko602_check_then_act"),
+        ("AIKO603", "aiko603_blocking_under_lock"),
+        ("AIKO604", "aiko604_lock_inversion"),
+        ("AIKO605", "aiko605_mutable_class_default"),
+    ])
+    def test_rule_fires_on_golden_fixture(self, code, stem):
+        report = run_code_pass([GOLDEN / f"{stem}.py"], root=GOLDEN)
+        assert code in {d.code for d in report.findings}, \
+            report.render()
+
+    def test_historical_pipeline_load_repro_is_aiko601(self):
+        """The round-19 `Pipeline.load()` bug -- live iteration of the
+        stream dict the event loop mutates -- must stay detected."""
+        report = run_code_pass(
+            [GOLDEN / "aiko601_live_dict_iteration.py"], root=GOLDEN)
+        hits = [d for d in report.findings if d.code == "AIKO601"]
+        assert hits, report.render()
+        finding = hits[0]
+        assert finding.definition == "ReplayPipeline"
+        assert finding.element == "load"
+        assert finding.port == "streams"
+
+    def test_loop_affine_roles_never_race_each_other(self):
+        """A timer iterating a dict only the mailbox mutates shares
+        the one event-loop thread: no finding."""
+        source = (
+            "class QuietActor:\n"
+            "    def __init__(self):\n"
+            "        self.add_mailbox_handler(self._on_mail, 't')\n"
+            "        self.add_timer_handler(self._tick, 1.0)\n"
+            "        self.jobs = {}\n"
+            "    def _on_mail(self, message):\n"
+            "        self.jobs[message] = 1\n"
+            "    def _tick(self):\n"
+            "        for job in self.jobs.values():\n"
+            "            job.poke()\n")
+        report = _run_on_source(source)
+        assert not report.findings, report.render()
+
+    def test_snapshot_iteration_is_clean(self):
+        """`list()` before iterating -- the prescribed fix -- clears
+        the finding even against a worker-thread mutator."""
+        source = (
+            "import threading\n"
+            "class SnapActor:\n"
+            "    def __init__(self):\n"
+            "        self.jobs = {}\n"
+            "        threading.Thread(target=self._pump).start()\n"
+            "    def _pump(self):\n"
+            "        self.jobs.clear()\n"
+            "    def walk(self):\n"
+            "        for job in list(self.jobs.values()):\n"
+            "            job.poke()\n")
+        report = _run_on_source(source)
+        assert "AIKO601" not in {d.code for d in report.findings}, \
+            report.render()
+
+
+def _run_on_source(source, tmp_path=None, name="fixture_module.py"):
+    import tempfile
+    with tempfile.TemporaryDirectory() as directory:
+        path = Path(directory) / name
+        path.write_text(source)
+        return run_code_pass([path], root=Path(directory))
+
+
+class TestSuppression:
+    def test_allow_comment_suppresses_finding(self):
+        source = (GOLDEN / "aiko601_live_dict_iteration.py").read_text()
+        patched = source.replace(
+            "for stream_id, stream in self.streams.items():",
+            "for stream_id, stream in self.streams.items():"
+            "  # aiko: allow")
+        assert not _run_on_source(patched).findings
+
+    def test_allow_comment_on_any_line_of_multiline_statement(self):
+        source = (
+            "import threading\n"
+            "class SpanActor:\n"
+            "    def __init__(self):\n"
+            "        self.jobs = {}\n"
+            "        threading.Thread(target=self._pump).start()\n"
+            "    def _pump(self):\n"
+            "        self.jobs.clear()\n"
+            "    def walk(self):\n"
+            "        for job in (\n"
+            "                self.jobs.values()):  # aiko: allow\n"
+            "            job.poke()\n")
+        assert not _run_on_source(source).findings
+
+    def test_statement_suppressed_helper_spans_statements(self):
+        source = ("value = [\n"
+                  "    1,\n"
+                  "    2,  # aiko: allow\n"
+                  "]\n"
+                  "other = 3\n")
+        lines = source.splitlines()
+        statements = ast.parse(source).body
+        assert statement_suppressed(lines, statements[0])
+        assert not statement_suppressed(lines, statements[1])
+
+
+class TestBaseline:
+    def test_write_then_apply_filters_everything(self, tmp_path):
+        report = run_code_pass([GOLDEN], root=GOLDEN)
+        assert report.findings
+        baseline_path = tmp_path / "baseline.json"
+        count = write_baseline(baseline_path, report)
+        assert count == len({finding_fingerprint(d)
+                             for d in report.findings})
+        entries = load_baseline(baseline_path)
+        fresh = run_code_pass([GOLDEN], root=GOLDEN)
+        filtered = apply_baseline(fresh, entries)
+        assert filtered == len(report.findings)
+        assert not fresh.failures(strict=True)
+
+    def test_stale_entry_surfaces_as_aiko600_info(self):
+        report = run_code_pass([GOLDEN], root=GOLDEN)
+        stale = "AIKO601 gone.py Gone.method attribute"
+        apply_baseline(report, [stale])
+        notes = [d for d in report.findings if d.code == "AIKO600"]
+        assert any(stale in d.message for d in notes)
+        # stale entries nag but never fail the build
+        assert all(d.severity == "info" for d in notes)
+
+    def test_new_finding_not_masked_by_unrelated_baseline(self):
+        report = run_code_pass(
+            [GOLDEN / "aiko601_live_dict_iteration.py"], root=GOLDEN)
+        apply_baseline(
+            report, ["AIKO602 other.py Other.method attribute"])
+        assert "AIKO601" in {d.code for d in report.findings}
+
+    def test_committed_baseline_matches_tree(self):
+        """CI contract: `aiko lint --code aiko_services_tpu/ --strict`
+        against the committed baseline reports nothing new."""
+        report = run_code_pass([PACKAGE], root=REPO)
+        apply_baseline(report, load_baseline(BASELINE))
+        leftovers = report.failures(strict=True)
+        assert not leftovers, "\n".join(d.render() for d in leftovers)
+        stale = [d for d in report.findings if d.code == "AIKO600"]
+        assert not stale, "\n".join(d.render() for d in stale)
+
+
+class TestDeterminism:
+    def test_two_runs_render_byte_identical_json(self):
+        first = run_code_pass([PACKAGE], root=REPO).to_json()
+        second = run_code_pass([PACKAGE], root=REPO).to_json()
+        assert first == second
+
+    def test_cli_code_mode_clean_against_baseline(self, tmp_path):
+        from click.testing import CliRunner
+
+        from aiko_services_tpu.cli import main
+
+        output = tmp_path / "report.json"
+        result = CliRunner().invoke(main, [
+            "lint", "--code", str(PACKAGE), "--strict", "--format",
+            "json", "--baseline", str(BASELINE),
+            "--output", str(output)])
+        assert result.exit_code == 0, result.output
+        document = json.loads(output.read_text())
+        assert document["summary"]["errors"] == 0
+        assert document["summary"]["warnings"] == 0
+
+
+class TestChurnStormRegressions:
+    """The fixed `Pipeline.load()`-class sites, exercised the way the
+    detector says they break: a thread mutating the container while
+    the (now snapshotting) reader iterates.  Dict/set iteration
+    raises RuntimeError mid-churn without the `list()` fix."""
+
+    ROUNDS = 300
+
+    def _storm(self, mutate, read):
+        stop = threading.Event()
+        errors = []
+
+        def churn():
+            index = 0
+            while not stop.is_set():
+                try:
+                    mutate(index)
+                except Exception as error:  # pragma: no cover
+                    errors.append(error)
+                    return
+                index += 1
+
+        thread = threading.Thread(target=churn, daemon=True)
+        thread.start()
+        try:
+            for _ in range(self.ROUNDS):
+                read()
+        finally:
+            stop.set()
+            thread.join(timeout=5.0)
+        assert not errors, errors
+
+    def _gateway(self):
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.serve import Gateway
+        from aiko_services_tpu.transport import reset_brokers
+
+        reset_brokers()
+        process = Process(transport_kind="loopback")
+        return Gateway(process, policy="max_inflight=64;queue=256",
+                       router_seed=1, metrics_interval=3600.0)
+
+    def test_signal_throttle_survives_stream_churn(self):
+        gateway = self._gateway()
+
+        def mutate(index):
+            key = f"s{index % 8}"
+            if key in gateway.streams:
+                del gateway.streams[key]
+            else:
+                gateway.streams[key] = SimpleNamespace(throttled=False)
+
+        self._storm(mutate, lambda: gateway._signal_throttle(0.0))
+
+    def test_set_replica_parameter_survives_replica_churn(self):
+        gateway = self._gateway()
+
+        def mutate(index):
+            key = f"r{index % 8}"
+            if key in gateway.replicas:
+                del gateway.replicas[key]
+            else:
+                gateway.replicas[key] = SimpleNamespace(
+                    dead=True, draining=False)
+
+        self._storm(
+            mutate,
+            lambda: gateway.set_replica_parameter("lm", "k", "v"))
+
+    def test_bucket_levels_survives_bucket_churn(self):
+        from aiko_services_tpu.serve import TokenBucket
+
+        gateway = self._gateway()
+
+        def mutate(index):
+            key = index % 8
+            if key in gateway.policy.buckets:
+                del gateway.policy.buckets[key]
+            else:
+                gateway.policy.buckets[key] = TokenBucket(10.0, 10.0)
+
+        self._storm(mutate, gateway._bucket_levels)
+
+    def test_queue_depth_survives_parked_churn(self):
+        gateway = self._gateway()
+
+        def mutate(index):
+            if gateway._parked and index % 2:
+                gateway._parked.pop()
+            else:
+                gateway._parked.append((index % 3, index, f"s{index}",
+                                        f"f{index}"))
+
+        self._storm(mutate, gateway._note_queue_depth)
+
+    def test_ec_consumer_notify_survives_handler_self_removal(self):
+        """A change handler de-registering DURING notification must
+        not starve the handlers behind it (live-list iteration used
+        to skip the next handler)."""
+        from aiko_services_tpu.runtime.share import ECConsumer
+
+        consumer = ECConsumer.__new__(ECConsumer)
+        calls = []
+
+        def selfish(consumer_, command, name, value):
+            calls.append("selfish")
+            consumer_._change_handlers.remove(selfish)
+
+        def bystander(consumer_, command, name, value):
+            calls.append("bystander")
+
+        consumer._change_handlers = [selfish, bystander]
+        consumer._notify("add", "x", 1)
+        assert calls == ["selfish", "bystander"]
+
+    def test_process_rejoin_survives_service_churn(self):
+        from aiko_services_tpu.runtime import Process
+        from aiko_services_tpu.transport import reset_brokers
+
+        reset_brokers()
+        process = Process(transport_kind="loopback")
+        process.publish = lambda *args, **kwargs: None
+        process._register_service = lambda fields: None
+        process.registrar = SimpleNamespace()
+        process.connection.is_connected = lambda state: True
+
+        def mutate(index):
+            key = f"svc{index % 8}"
+            if key in process._services:
+                del process._services[key]
+            else:
+                process._services[key] = SimpleNamespace(
+                    service_fields=lambda: None)
+
+        self._storm(mutate, process.rejoin)
